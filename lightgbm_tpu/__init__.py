@@ -1,0 +1,23 @@
+"""lightgbm_tpu — a TPU-native gradient boosting framework.
+
+A from-scratch re-architecture of LightGBM's capabilities
+(ref: /root/reference, nagyist/LightGBM v4.6) for TPU: host-side quantile
+binning, a leaf-wise tree learner compiled to XLA (histograms as MXU
+one-hot contractions, vectorized split search, mask-based partition),
+objectives/metrics, data-parallel training via jax.sharding over an ICI
+mesh, and a python API mirroring the reference python-package.
+"""
+
+from .basic import Booster, Dataset, LightGBMError  # noqa: F401
+from .callback import (EarlyStopException, early_stopping,  # noqa: F401
+                       log_evaluation, record_evaluation, reset_parameter)
+from .engine import CVBooster, cv, train  # noqa: F401
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset", "Booster", "LightGBMError",
+    "train", "cv", "CVBooster",
+    "early_stopping", "log_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
+]

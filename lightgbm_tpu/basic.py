@@ -1,0 +1,450 @@
+"""User-facing Dataset / Booster API.
+
+Mirrors the reference python-package surface
+(ref: python-package/lightgbm/basic.py:1692 Dataset, :3495 Booster) with
+lazy Dataset construction, aligned validation binning via `reference=`,
+and a Booster wrapping the TPU boosting engine instead of ctypes into
+lib_lightgbm.so.
+"""
+
+from __future__ import annotations
+
+import json
+from copy import deepcopy
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import numpy as np
+
+from .boosting import GBDT, create_boosting
+from .config import Config
+from .dataset import BinnedDataset, Metadata
+from .metrics import create_metrics
+from .model_io import (dump_model_to_json, load_model_from_string,
+                       save_model_to_string, LoadedModel)
+from .objectives import create_objective
+
+
+class LightGBMError(Exception):
+    """(ref: basic.py LightGBMError)"""
+
+
+def _to_2d(data) -> np.ndarray:
+    arr = np.asarray(data, dtype=np.float64)
+    if arr.ndim == 1:
+        arr = arr.reshape(-1, 1)
+    return arr
+
+
+class Dataset:
+    """Lazily-constructed training dataset (ref: basic.py:1692)."""
+
+    def __init__(self, data, label=None, reference: Optional["Dataset"] = None,
+                 weight=None, group=None, init_score=None,
+                 feature_name: Union[str, List[str]] = "auto",
+                 categorical_feature: Union[str, List] = "auto",
+                 params: Optional[Dict[str, Any]] = None,
+                 free_raw_data: bool = False, position=None):
+        if isinstance(data, (str, Path)):
+            from .io.text_loader import load_svmlight_or_csv
+            data, file_label, file_weight, file_group = \
+                load_svmlight_or_csv(str(data), params or {})
+            if label is None:
+                label = file_label
+            if weight is None:
+                weight = file_weight
+            if group is None:
+                group = file_group
+        self.data = _to_2d(data)
+        self.label = label
+        self.weight = weight
+        self.group = group
+        self.init_score = init_score
+        self.position = position
+        self.reference = reference
+        self.feature_name = feature_name
+        self.categorical_feature = categorical_feature
+        self.params = dict(params or {})
+        self.free_raw_data = free_raw_data
+        self._binned: Optional[BinnedDataset] = None
+        self.used_indices = None
+
+    # ------------------------------------------------------------------
+    def construct(self) -> "Dataset":
+        if self._binned is not None:
+            return self
+        cfg = Config.from_params(self.params)
+        meta = Metadata(self.data.shape[0])
+        if self.label is not None:
+            meta.set_label(self.label)
+        else:
+            meta.set_label(np.zeros(self.data.shape[0]))
+        meta.set_weight(self.weight)
+        if self.group is not None:
+            meta.set_group(self.group)
+        meta.set_init_score(self.init_score)
+        if self.position is not None:
+            meta.set_position(self.position)
+
+        cat_indices: List[int] = []
+        names = self._feature_names()
+        if isinstance(self.categorical_feature, (list, tuple)):
+            for c in self.categorical_feature:
+                if isinstance(c, str) and c in names:
+                    cat_indices.append(names.index(c))
+                elif isinstance(c, (int, np.integer)):
+                    cat_indices.append(int(c))
+
+        ref_binned = None
+        if self.reference is not None:
+            self.reference.construct()
+            ref_binned = self.reference._binned
+
+        forced_bins = None
+        fb_file = cfg.forcedbins_filename
+        if fb_file:
+            with open(fb_file) as fh:
+                spec = json.load(fh)
+            forced_bins = {int(e["feature"]): e["bin_upper_bound"]
+                           for e in spec}
+
+        self._binned = BinnedDataset.from_matrix(
+            self.data, cfg, metadata=meta,
+            categorical_features=cat_indices,
+            feature_names=names, reference=ref_binned,
+            forced_bins=forced_bins)
+        return self
+
+    def _feature_names(self) -> List[str]:
+        if isinstance(self.feature_name, list):
+            return list(self.feature_name)
+        return [f"Column_{i}" for i in range(self.data.shape[1])]
+
+    # ------------------------------------------------------------------
+    def set_label(self, label) -> "Dataset":
+        self.label = label
+        if self._binned is not None:
+            self._binned.metadata.set_label(label)
+        return self
+
+    def set_weight(self, weight) -> "Dataset":
+        self.weight = weight
+        if self._binned is not None:
+            self._binned.metadata.set_weight(weight)
+        return self
+
+    def set_group(self, group) -> "Dataset":
+        self.group = group
+        if self._binned is not None:
+            self._binned.metadata.set_group(group)
+        return self
+
+    def set_init_score(self, init_score) -> "Dataset":
+        self.init_score = init_score
+        if self._binned is not None:
+            self._binned.metadata.set_init_score(init_score)
+        return self
+
+    def get_label(self):
+        return self.label
+
+    def get_weight(self):
+        return self.weight
+
+    def get_group(self):
+        return self.group
+
+    def get_init_score(self):
+        return self.init_score
+
+    def get_data(self):
+        return self.data
+
+    def num_data(self) -> int:
+        return self.data.shape[0]
+
+    def num_feature(self) -> int:
+        return self.data.shape[1]
+
+    def get_feature_name(self) -> List[str]:
+        return self._feature_names()
+
+    def subset(self, used_indices: Sequence[int],
+               params: Optional[Dict] = None) -> "Dataset":
+        """Row-subset view (ref: basic.py Dataset.subset)."""
+        idx = np.asarray(used_indices)
+        sub = Dataset(
+            self.data[idx],
+            label=None if self.label is None else np.asarray(self.label)[idx],
+            weight=None if self.weight is None else np.asarray(self.weight)[idx],
+            init_score=None if self.init_score is None
+            else np.asarray(self.init_score)[idx],
+            feature_name=self.feature_name,
+            categorical_feature=self.categorical_feature,
+            params=params or self.params,
+            reference=self if self._binned is not None else None)
+        sub.used_indices = idx
+        return sub
+
+    def create_valid(self, data, label=None, weight=None, group=None,
+                     init_score=None, params=None) -> "Dataset":
+        return Dataset(data, label=label, weight=weight, group=group,
+                       init_score=init_score, reference=self,
+                       params=params or self.params)
+
+    def save_binary(self, filename) -> "Dataset":
+        """Binary serialization of the binned dataset
+        (ref: Dataset::SaveBinaryFile dataset.h:710)."""
+        self.construct()
+        from .io.binary_format import save_dataset_binary
+        save_dataset_binary(self, filename)
+        return self
+
+
+class Booster:
+    """Training/prediction handle (ref: basic.py:3495)."""
+
+    def __init__(self, params: Optional[Dict[str, Any]] = None,
+                 train_set: Optional[Dataset] = None,
+                 model_file: Optional[str] = None,
+                 model_str: Optional[str] = None):
+        self.params = dict(params or {})
+        self.best_iteration = -1
+        self.best_score: Dict = {}
+        self._loaded: Optional[LoadedModel] = None
+        self._gbdt: Optional[GBDT] = None
+        self.train_set: Optional[Dataset] = None
+        self._valid_sets: List[Dataset] = []
+        self._name_valid_sets: List[str] = []
+        self._metrics_cache: Dict[int, list] = {}
+        self._network_params = None
+
+        if model_file is not None:
+            with open(model_file) as fh:
+                self._loaded = load_model_from_string(fh.read())
+            return
+        if model_str is not None:
+            self._loaded = load_model_from_string(model_str)
+            return
+        if train_set is None:
+            raise LightGBMError(
+                "Booster requires train_set, model_file or model_str")
+
+        self.config = Config.from_params(self.params)
+        train_set.params = {**train_set.params, **self.params}
+        train_set.construct()
+        self.train_set = train_set
+        objective = create_objective(self.config)
+        if objective is None and self.config.objective not in ("none",):
+            raise LightGBMError(f"unknown objective {self.config.objective}")
+        binned = train_set._binned
+        if self.config.tree_learner in ("data", "voting", "feature") or \
+                self.config.num_machines > 1 or \
+                int(self.params.get("tpu_num_shards", 0) or 0) > 1:
+            from .parallel.data_parallel import create_parallel_boosting
+            self._gbdt = create_parallel_boosting(self.config, binned,
+                                                  objective)
+        else:
+            self._gbdt = create_boosting(self.config, binned, objective)
+
+    # ------------------------------------------------------------------
+    def add_valid(self, data: Dataset, name: str) -> "Booster":
+        data.reference = data.reference or self.train_set
+        data.construct()
+        self._valid_sets.append(data)
+        self._name_valid_sets.append(name)
+        self._gbdt.add_valid(data._binned, data.data)
+        return self
+
+    def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
+        """One boosting iteration; True means training should stop
+        (ref: basic.py Booster.update -> LGBM_BoosterUpdateOneIter)."""
+        if fobj is not None:
+            grad, hess = fobj(self._raw_train_scores(), self.train_set)
+            return self._gbdt.train_one_iter(np.asarray(grad),
+                                             np.asarray(hess))
+        return self._gbdt.train_one_iter()
+
+    def _raw_train_scores(self) -> np.ndarray:
+        s = np.asarray(self._gbdt.scores)
+        return s[0] if s.shape[0] == 1 else s.T.reshape(-1)
+
+    def rollback_one_iter(self) -> "Booster":
+        self._gbdt.rollback_one_iter()
+        return self
+
+    def current_iteration(self) -> int:
+        if self._loaded is not None:
+            return self._loaded.num_iterations
+        return self._gbdt.current_iteration()
+
+    def num_trees(self) -> int:
+        if self._loaded is not None:
+            return len(self._loaded.trees)
+        return self._gbdt.num_trees
+
+    def num_feature(self) -> int:
+        if self._loaded is not None:
+            return self._loaded.max_feature_idx + 1
+        return self.train_set.num_feature()
+
+    def feature_name(self) -> List[str]:
+        if self._loaded is not None:
+            return self._loaded.feature_names
+        return self.train_set.get_feature_name()
+
+    # ------------------------------------------------------------------
+    def _metrics_for(self, ds_binned, num_data: int):
+        key = id(ds_binned)
+        if key not in self._metrics_cache:
+            names = self.config.metric or self.config.default_metric()
+            ms = create_metrics(self.config, names)
+            for m in ms:
+                m.init(ds_binned.metadata, num_data)
+            self._metrics_cache[key] = ms
+        return self._metrics_cache[key]
+
+    def _eval_scores(self, raw: np.ndarray, binned, name: str):
+        obj = self._gbdt.objective
+        raw2 = raw if raw.ndim == 2 else raw[:, None]
+        squeezed = raw2[:, 0] if raw2.shape[1] == 1 else raw2
+        prob = obj.convert_output(squeezed) if obj is not None else squeezed
+        out = []
+        for metric in self._metrics_for(binned, binned.num_data):
+            for mname, value, hib in metric.eval(prob, squeezed):
+                out.append((name, mname, value, hib))
+        return out
+
+    def eval_train(self, feval=None):
+        raw = np.asarray(self._gbdt.scores).T  # [N, K]
+        res = self._eval_scores(raw, self.train_set._binned, "training")
+        if feval is not None:
+            res += _call_feval(feval, raw, self.train_set, "training")
+        return res
+
+    def eval_valid(self, feval=None):
+        out = []
+        for i, (vs, name) in enumerate(zip(self._valid_sets,
+                                           self._name_valid_sets)):
+            raw = self._gbdt.valid_raw_scores(i)  # [N, K]
+            out += self._eval_scores(raw, vs._binned, name)
+            if feval is not None:
+                out += _call_feval(feval, raw, vs, name)
+        return out
+
+    def eval(self, data: Dataset, name: str, feval=None):
+        for i, vs in enumerate(self._valid_sets):
+            if vs is data:
+                raw = self._gbdt.valid_raw_scores(i)
+                res = self._eval_scores(raw, vs._binned, name)
+                if feval is not None:
+                    res += _call_feval(feval, raw, vs, name)
+                return res
+        raw = self._gbdt.predict_raw(data.data)
+        res = self._eval_scores(raw, data.construct()._binned, name)
+        if feval is not None:
+            res += _call_feval(feval, raw, data, name)
+        return res
+
+    # ------------------------------------------------------------------
+    def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
+                raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        data = np.asarray(data, dtype=np.float64)
+        if data.ndim == 1:
+            data = data.reshape(1, -1)
+        if self._loaded is not None:
+            if pred_leaf or pred_contrib:
+                raise LightGBMError("pred_leaf/contrib need a trained booster")
+            return self._loaded.predict(data, raw_score=raw_score,
+                                        start_iteration=start_iteration,
+                                        num_iteration=num_iteration)
+        if num_iteration < 0 and self.best_iteration > 0:
+            num_iteration = self.best_iteration
+        return self._gbdt.predict(data, raw_score=raw_score,
+                                  start_iteration=start_iteration,
+                                  num_iteration=num_iteration,
+                                  pred_leaf=pred_leaf,
+                                  pred_contrib=pred_contrib)
+
+    def refit(self, data, label, decay_rate: float = 0.9, **kwargs):
+        """(ref: Booster.refit basic.py; GBDT::RefitTree gbdt.cpp:267)"""
+        from .refit import refit_booster
+        return refit_booster(self, data, label, decay_rate)
+
+    # ------------------------------------------------------------------
+    def model_to_string(self, num_iteration: int = -1,
+                        start_iteration: int = 0,
+                        importance_type: str = "split") -> str:
+        return save_model_to_string(self._gbdt, num_iteration,
+                                    start_iteration, importance_type)
+
+    def save_model(self, filename, num_iteration: int = -1,
+                   start_iteration: int = 0,
+                   importance_type: str = "split") -> "Booster":
+        with open(filename, "w") as fh:
+            fh.write(self.model_to_string(num_iteration, start_iteration,
+                                          importance_type))
+        return self
+
+    def dump_model(self, num_iteration: int = -1, start_iteration: int = 0
+                   ) -> dict:
+        return dump_model_to_json(self._gbdt, num_iteration, start_iteration)
+
+    def feature_importance(self, importance_type: str = "split",
+                           iteration: int = -1) -> np.ndarray:
+        return self._gbdt.feature_importance(importance_type, iteration)
+
+    def reset_parameter(self, params: Dict[str, Any]) -> "Booster":
+        self.params.update(params)
+        self.config.update(params)
+        self._gbdt.config = self.config
+        from .ops.split import SplitHyperParams
+        self._gbdt.hp = SplitHyperParams.from_config(self.config)
+        self._gbdt.shrinkage_rate = self.config.learning_rate
+        return self
+
+    def free_dataset(self) -> "Booster":
+        return self
+
+    def free_network(self) -> "Booster":
+        self._network_params = None
+        return self
+
+    def set_network(self, machines, local_listen_port=12400,
+                    listen_time_out=120, num_machines=1) -> "Booster":
+        self._network_params = dict(machines=machines,
+                                    local_listen_port=local_listen_port,
+                                    num_machines=num_machines)
+        return self
+
+    def shuffle_models(self, start_iteration=0, end_iteration=-1) -> "Booster":
+        models = self._gbdt.models
+        end = len(models) if end_iteration < 0 else end_iteration
+        seg = models[start_iteration:end]
+        np.random.shuffle(seg)
+        self._gbdt.models = models[:start_iteration] + list(seg) + \
+            models[end:]
+        return self
+
+    def __copy__(self):
+        return self
+
+    def __deepcopy__(self, memo):
+        model_str = self.model_to_string()
+        return Booster(model_str=model_str)
+
+
+def _call_feval(feval, raw, dataset, name):
+    out = []
+    fevals = feval if isinstance(feval, (list, tuple)) else [feval]
+    preds = raw[:, 0] if raw.ndim == 2 and raw.shape[1] == 1 else raw
+    for f in fevals:
+        res = f(preds, dataset)
+        if isinstance(res, list):
+            for mname, value, hib in res:
+                out.append((name, mname, value, hib))
+        else:
+            mname, value, hib = res
+            out.append((name, mname, value, hib))
+    return out

@@ -1,0 +1,285 @@
+"""Host-side feature binning.
+
+Re-implementation of the reference binning semantics
+(ref: include/LightGBM/bin.h:86 BinMapper, src/io/bin.cpp:81 GreedyFindBin,
+src/io/bin.cpp:247 FindBinWithZeroAsOneBin, src/io/bin.cpp:316 FindBin) in
+NumPy. Binning runs once on the host at Dataset construction; the result is
+a dense feature-major bin tensor shipped to the TPU (the analog of
+CUDARowData, include/LightGBM/cuda/cuda_row_data.hpp:33).
+
+Semantics preserved:
+  - greedy quantile bins: each distinct value its own bin when few distincts;
+    otherwise ~equal-count bins, with any single value holding >= mean bin
+    count isolated in its own bin;
+  - zero always gets its own bin (zero threshold +/-1e-35);
+  - missing handling None/Zero/NaN: NaN values get a dedicated last bin
+    (missing_type NAN) or map to the zero bin (zero_as_missing);
+  - categorical: categories sorted by frequency, capped at max_bin, rare
+    categories filtered;
+  - trivial features (single bin) are dropped from training.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+K_ZERO_THRESHOLD = 1e-35
+MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
+_MISSING_NAMES = {MISSING_NONE: "none", MISSING_ZERO: "zero", MISSING_NAN: "nan"}
+
+
+def _greedy_find_bin(distinct_values: np.ndarray, counts: np.ndarray,
+                     max_bin: int, total_cnt: int,
+                     min_data_in_bin: int) -> List[float]:
+    """Upper bounds for ~equal-count bins over sorted distinct values
+    (ref: src/io/bin.cpp:81). Returns list of upper bounds; last is +inf."""
+    num_distinct = len(distinct_values)
+    bounds: List[float] = []
+    if num_distinct == 0:
+        return [np.inf]
+    if num_distinct <= max_bin:
+        # each distinct value gets a bin, merging tiny bins forward
+        cur_cnt = 0
+        for i in range(num_distinct - 1):
+            cur_cnt += counts[i]
+            if cur_cnt >= min_data_in_bin:
+                bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                cur_cnt = 0
+        bounds.append(np.inf)
+        return bounds
+
+    # greedy: targets of mean size; isolate heavy hitters
+    max_bin = max(1, max_bin)
+    mean_bin_size = total_cnt / max_bin
+    is_big = counts >= mean_bin_size
+    rest_cnt = total_cnt - counts[is_big].sum()
+    rest_bins = max_bin - int(is_big.sum())
+    if rest_bins > 0:
+        mean_bin_size = rest_cnt / rest_bins
+
+    bin_cnt = 0
+    bins_left = max_bin
+    for i in range(num_distinct):
+        bin_cnt += counts[i]
+        # close the bin if: heavy hitter, reached target size, or the next
+        # value is heavy (so it starts its own bin)
+        next_big = is_big[i + 1] if i + 1 < num_distinct else False
+        if i == num_distinct - 1:
+            break
+        if is_big[i] or bin_cnt >= mean_bin_size or \
+                (next_big and bin_cnt >= max(1.0, mean_bin_size * 0.5)):
+            if bin_cnt >= min_data_in_bin or is_big[i]:
+                bounds.append((distinct_values[i] + distinct_values[i + 1]) / 2.0)
+                bin_cnt = 0
+                bins_left -= 1
+                if bins_left <= 1:
+                    break
+    bounds.append(np.inf)
+    return bounds
+
+
+class BinMapper:
+    """Per-feature value <-> bin mapping (ref: include/LightGBM/bin.h:86)."""
+
+    def __init__(self):
+        self.num_bins: int = 1
+        self.is_categorical: bool = False
+        self.missing_type: int = MISSING_NONE
+        self.bin_upper_bound: Optional[np.ndarray] = None  # numerical
+        self.cat_bin_to_value: Optional[np.ndarray] = None  # categorical
+        self.cat_value_to_bin: Optional[dict] = None
+        self.default_bin: int = 0      # bin of value 0.0
+        self.most_freq_bin: int = 0
+        self.min_value: float = 0.0
+        self.max_value: float = 0.0
+        self.is_trivial: bool = True
+
+    # ------------------------------------------------------------------
+    def fit(self, values: np.ndarray, *, max_bin: int = 255,
+            min_data_in_bin: int = 3, use_missing: bool = True,
+            zero_as_missing: bool = False,
+            is_categorical: bool = False,
+            forced_bounds: Optional[Sequence[float]] = None) -> "BinMapper":
+        values = np.asarray(values, dtype=np.float64)
+        na_mask = np.isnan(values)
+        na_cnt = int(na_mask.sum())
+        clean = values[~na_mask]
+        self.is_categorical = is_categorical
+
+        if is_categorical:
+            self._fit_categorical(clean, na_cnt, max_bin, min_data_in_bin,
+                                  use_missing)
+            return self
+
+        # missing type resolution (ref: bin.cpp:316 FindBin)
+        if not use_missing:
+            self.missing_type = MISSING_NONE
+        elif zero_as_missing:
+            self.missing_type = MISSING_ZERO
+        elif na_cnt > 0:
+            self.missing_type = MISSING_NAN
+        else:
+            self.missing_type = MISSING_NONE
+
+        if zero_as_missing:
+            # zeros (and NaN) are treated as missing -> zero bin
+            clean = clean[np.abs(clean) > K_ZERO_THRESHOLD]
+
+        if clean.size == 0:
+            self.bin_upper_bound = np.array([np.inf])
+            self.num_bins = 1 + (1 if self.missing_type == MISSING_NAN else 0)
+            self._finalize_numerical(values, na_cnt)
+            return self
+
+        self.min_value = float(clean.min())
+        self.max_value = float(clean.max())
+
+        distinct, counts = np.unique(clean, return_counts=True)
+
+        if forced_bounds is not None and len(forced_bounds) > 0:
+            inner = sorted(float(b) for b in forced_bounds
+                           if self.min_value < b < self.max_value)
+            bounds = inner + [np.inf]
+        else:
+            # zero-as-one-bin (ref: bin.cpp:247): bin the negative and
+            # positive halves separately, keep [-eps, eps] as zero's own bin
+            neg = distinct < -K_ZERO_THRESHOLD
+            pos = distinct > K_ZERO_THRESHOLD
+            zero_cnt = int(counts[~neg & ~pos].sum())
+            n_neg, n_pos = int(neg.sum()), int(pos.sum())
+            total = int(counts.sum())
+            avail = max_bin - 1  # reserve NaN bin later via max_bin arg below
+            if self.missing_type == MISSING_NAN:
+                avail = max(avail, 1)
+            else:
+                avail = max_bin
+            # share bins between halves proportional to distinct counts
+            left_max = int(round(avail * n_neg / max(n_neg + n_pos, 1)))
+            left_max = min(max(left_max, 1 if n_neg else 0), avail - (1 if n_pos else 0))
+            right_max = avail - left_max - 1  # -1 for the zero bin
+            bounds = []
+            if n_neg:
+                lb = _greedy_find_bin(distinct[neg], counts[neg],
+                                      max(left_max, 1), int(counts[neg].sum()),
+                                      min_data_in_bin)
+                bounds.extend(b for b in lb[:-1])
+                bounds.append(-K_ZERO_THRESHOLD)
+            if n_pos:
+                bounds.append(K_ZERO_THRESHOLD)
+                rb = _greedy_find_bin(distinct[pos], counts[pos],
+                                      max(right_max, 1), int(counts[pos].sum()),
+                                      min_data_in_bin)
+                bounds.extend(b for b in rb[:-1])
+            elif zero_cnt or n_neg:
+                bounds.append(K_ZERO_THRESHOLD)
+            bounds.append(np.inf)
+            bounds = sorted(set(bounds))
+
+        self.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+        self.num_bins = len(bounds)
+        if self.missing_type == MISSING_NAN:
+            self.num_bins += 1  # dedicated NaN bin at the end
+        self._finalize_numerical(values, na_cnt)
+        return self
+
+    def _finalize_numerical(self, values: np.ndarray, na_cnt: int) -> None:
+        self.default_bin = int(np.searchsorted(self.bin_upper_bound, 0.0,
+                                               side="left"))
+        binned = self.transform(values)
+        if binned.size:
+            bc = np.bincount(binned, minlength=self.num_bins)
+            self.most_freq_bin = int(bc.argmax())
+        self.is_trivial = self._count_effective_bins(values) <= 1
+
+    def _count_effective_bins(self, values: np.ndarray) -> int:
+        if values.size == 0:
+            return 1
+        return int(len(np.unique(self.transform(values))))
+
+    def _fit_categorical(self, clean: np.ndarray, na_cnt: int, max_bin: int,
+                         min_data_in_bin: int, use_missing: bool) -> None:
+        # (ref: bin.cpp FindBin categorical branch): categories sorted by
+        # frequency, capped at max_bin; negative values treated as missing.
+        cats = clean[clean >= 0].astype(np.int64)
+        self.missing_type = (MISSING_NAN
+                             if (na_cnt > 0 or clean.size != cats.size)
+                             and use_missing else MISSING_NONE)
+        if cats.size:
+            distinct, counts = np.unique(cats, return_counts=True)
+            order = np.argsort(-counts, kind="stable")
+            distinct, counts = distinct[order], counts[order]
+            keep = min(len(distinct), max_bin - 1)
+            # drop ultra-rare categories like the reference's 99.9% cut
+            total = counts.sum()
+            cum = np.cumsum(counts)
+            cut = int(np.searchsorted(cum, total * 0.999)) + 1
+            keep = min(keep, max(cut, 1))
+            distinct = distinct[:keep]
+        else:
+            distinct = np.array([], dtype=np.int64)
+        # bin 0 = "other / missing"; known categories from bin 1
+        self.cat_bin_to_value = distinct
+        self.cat_value_to_bin = {int(v): i + 1 for i, v in enumerate(distinct)}
+        order2 = np.argsort(distinct, kind="stable")
+        self._cat_sorted_vals = distinct[order2]
+        self._cat_sorted_bins = (order2 + 1).astype(np.int32)
+        self.num_bins = 1 + len(distinct)
+        self.default_bin = 0
+        self.most_freq_bin = 1 if len(distinct) else 0
+        self.is_trivial = self.num_bins <= 2
+        if cats.size:
+            self.min_value = float(distinct.min())
+            self.max_value = float(distinct.max())
+
+    # ------------------------------------------------------------------
+    def transform(self, values: np.ndarray) -> np.ndarray:
+        """Vectorized value -> bin (ref: BinMapper::ValueToBin)."""
+        values = np.asarray(values, dtype=np.float64)
+        if self.is_categorical:
+            out = np.zeros(values.shape, dtype=np.int32)
+            if self.cat_bin_to_value is not None and len(self.cat_bin_to_value):
+                ok = np.isfinite(values) & (values >= 0)
+                iv = np.where(ok, values, -1).astype(np.int64)
+                pos = np.searchsorted(self._cat_sorted_vals, iv)
+                pos = np.clip(pos, 0, len(self._cat_sorted_vals) - 1)
+                hit = ok & (self._cat_sorted_vals[pos] == iv)
+                out = np.where(hit, self._cat_sorted_bins[pos], 0).astype(np.int32)
+            return out
+
+        na_mask = np.isnan(values)
+        if self.missing_type == MISSING_ZERO:
+            values = np.where(na_mask, 0.0, values)
+            na_mask = np.zeros_like(na_mask)
+        bins = np.searchsorted(self.bin_upper_bound, values, side="left")
+        bins = np.clip(bins, 0, len(self.bin_upper_bound) - 1)
+        if self.missing_type == MISSING_NAN:
+            bins = np.where(na_mask, self.num_bins - 1, bins)
+        else:
+            bins = np.where(na_mask, self.default_bin, bins)
+        return bins.astype(np.int32)
+
+    def bin_to_value(self, bin_idx: int) -> float:
+        """Threshold value for model serialization (ref: BinMapper::BinToValue)."""
+        if self.is_categorical:
+            if 1 <= bin_idx <= len(self.cat_bin_to_value):
+                return float(self.cat_bin_to_value[bin_idx - 1])
+            return -1.0
+        ub = self.bin_upper_bound
+        if bin_idx >= len(ub):
+            return float("inf")
+        return float(ub[bin_idx])
+
+    @property
+    def missing_name(self) -> str:
+        return _MISSING_NAMES[self.missing_type]
+
+    def feature_info_str(self) -> str:
+        """Feature info for the model header (ref: gbdt_model_text.cpp
+        feature_infos: `[min:max]` numerical, colon list categorical)."""
+        if self.is_trivial:
+            return "none"
+        if self.is_categorical:
+            return ":".join(str(int(v)) for v in self.cat_bin_to_value)
+        return f"[{self.min_value:g}:{self.max_value:g}]"

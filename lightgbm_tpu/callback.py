@@ -1,0 +1,138 @@
+"""Training callbacks (ref: python-package/lightgbm/callback.py:93-281)."""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List
+
+CallbackEnv = collections.namedtuple(
+    "CallbackEnv",
+    ["model", "params", "iteration", "begin_iteration", "end_iteration",
+     "evaluation_result_list"])
+
+
+class EarlyStopException(Exception):
+    """(ref: callback.py EarlyStopException)"""
+
+    def __init__(self, best_iteration: int, best_score):
+        super().__init__()
+        self.best_iteration = best_iteration
+        self.best_score = best_score
+
+
+def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
+    """(ref: callback.py:93 _LogEvaluationCallback)"""
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.evaluation_result_list and \
+                (env.iteration + 1) % period == 0:
+            result = "\t".join(
+                f"{name}'s {metric}: {value:g}"
+                for name, metric, value, _ in env.evaluation_result_list)
+            print(f"[{env.iteration + 1}]\t{result}")
+    _callback.order = 10
+    return _callback
+
+
+def record_evaluation(eval_result: Dict) -> Callable:
+    """(ref: callback.py:140 _RecordEvaluationCallback)"""
+    if not isinstance(eval_result, dict):
+        raise TypeError("eval_result must be a dict")
+
+    def _init(env: CallbackEnv) -> None:
+        eval_result.clear()
+        for name, metric, _, _ in env.evaluation_result_list:
+            eval_result.setdefault(name, collections.OrderedDict()) \
+                .setdefault(metric, [])
+
+    def _callback(env: CallbackEnv) -> None:
+        if not eval_result:
+            _init(env)
+        for name, metric, value, _ in env.evaluation_result_list:
+            eval_result.setdefault(name, collections.OrderedDict()) \
+                .setdefault(metric, []).append(value)
+    _callback.order = 20
+    return _callback
+
+
+def reset_parameter(**kwargs) -> Callable:
+    """(ref: callback.py:185 _ResetParameterCallback)"""
+    def _callback(env: CallbackEnv) -> None:
+        new_params = {}
+        for key, value in kwargs.items():
+            if isinstance(value, list):
+                if len(value) != env.end_iteration - env.begin_iteration:
+                    raise ValueError(
+                        f"Length of list {key!r} must match num_boost_round")
+                new_params[key] = value[env.iteration - env.begin_iteration]
+            elif callable(value):
+                new_params[key] = value(env.iteration - env.begin_iteration)
+        if new_params:
+            env.model.reset_parameter(new_params)
+    _callback.before_iteration = True
+    _callback.order = 10
+    return _callback
+
+
+def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
+                   verbose: bool = True, min_delta=0.0) -> Callable:
+    """(ref: callback.py:224 _EarlyStoppingCallback)"""
+    best_score: List[float] = []
+    best_iter: List[int] = []
+    best_score_list: List = []
+    cmp_op: List[Callable] = []
+    enabled = [True]
+    first_metric = [""]
+
+    def _is_train(name: str, env) -> bool:
+        return name == "training"
+
+    def _init(env: CallbackEnv) -> None:
+        enabled[0] = not env.params.get("boosting", "gbdt") == "rf"
+        if not env.evaluation_result_list:
+            raise ValueError(
+                "For early stopping, at least one validation set is required")
+        if verbose:
+            print(f"Training until validation scores don't improve for "
+                  f"{stopping_rounds} rounds")
+        n = len(env.evaluation_result_list)
+        deltas = (min_delta if isinstance(min_delta, list)
+                  else [min_delta] * n)
+        first_metric[0] = env.evaluation_result_list[0][1].split(" ")[-1]
+        for (name, metric, _, higher_better), delta in zip(
+                env.evaluation_result_list, deltas):
+            best_iter.append(0)
+            best_score_list.append(None)
+            if higher_better:
+                best_score.append(float("-inf"))
+                cmp_op.append(lambda x, y, d=delta: x > y + d)
+            else:
+                best_score.append(float("inf"))
+                cmp_op.append(lambda x, y, d=delta: x < y - d)
+
+    def _callback(env: CallbackEnv) -> None:
+        if not best_score:
+            _init(env)
+        if not enabled[0]:
+            return
+        for i, (name, metric, value, _) in \
+                enumerate(env.evaluation_result_list):
+            if best_score_list[i] is None or cmp_op[i](value, best_score[i]):
+                best_score[i] = value
+                best_iter[i] = env.iteration
+                best_score_list[i] = env.evaluation_result_list
+            if _is_train(name, env):
+                continue
+            if first_metric_only and first_metric[0] != metric.split(" ")[-1]:
+                continue
+            if env.iteration - best_iter[i] >= stopping_rounds:
+                if verbose:
+                    print(f"Early stopping, best iteration is:\n"
+                          f"[{best_iter[i] + 1}]")
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+            if env.iteration == env.end_iteration - 1:
+                if verbose:
+                    print(f"Did not meet early stopping. Best iteration is:\n"
+                          f"[{best_iter[i] + 1}]")
+                raise EarlyStopException(best_iter[i], best_score_list[i])
+    _callback.order = 30
+    return _callback

@@ -1,0 +1,208 @@
+"""Binned dataset core + metadata.
+
+TPU-native analog of the reference Dataset / Metadata / CUDARowData
+(ref: include/LightGBM/dataset.h:492,49; cuda/cuda_row_data.hpp:33).
+Host side: per-feature BinMappers over (sampled) raw data, a dense
+feature-major bin matrix, and label/weight/group metadata. Device side:
+the bin matrix as a `[F, N]` uint8/uint16 array (optionally sharded over a
+mesh axis for data-parallel training).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import binning
+from .binning import BinMapper
+from .config import Config
+
+
+class Metadata:
+    """Labels, weights, init scores, query boundaries
+    (ref: include/LightGBM/dataset.h:49)."""
+
+    def __init__(self, num_data: int):
+        self.num_data = num_data
+        self.label: Optional[np.ndarray] = None       # [N] f32
+        self.weight: Optional[np.ndarray] = None      # [N] f32
+        self.init_score: Optional[np.ndarray] = None  # [N] or [N*K] f64
+        self.query_boundaries: Optional[np.ndarray] = None  # [num_queries+1]
+        self.positions: Optional[np.ndarray] = None
+
+    def set_label(self, label) -> None:
+        label = np.asarray(label, dtype=np.float32).reshape(-1)
+        assert len(label) == self.num_data, "label length mismatch"
+        self.label = label
+
+    def set_weight(self, weight) -> None:
+        if weight is None:
+            self.weight = None
+            return
+        weight = np.asarray(weight, dtype=np.float32).reshape(-1)
+        assert len(weight) == self.num_data, "weight length mismatch"
+        self.weight = weight
+
+    def set_init_score(self, init_score) -> None:
+        if init_score is None:
+            self.init_score = None
+            return
+        self.init_score = np.asarray(init_score, dtype=np.float64)
+
+    def set_group(self, group) -> None:
+        """group: per-query sizes (like the python-package) -> boundaries."""
+        if group is None:
+            self.query_boundaries = None
+            return
+        group = np.asarray(group, dtype=np.int64).reshape(-1)
+        bounds = np.zeros(len(group) + 1, dtype=np.int32)
+        np.cumsum(group, out=bounds[1:])
+        assert bounds[-1] == self.num_data, "sum(group) must equal num_data"
+        self.query_boundaries = bounds
+
+    def set_position(self, position) -> None:
+        if position is None:
+            self.positions = None
+            return
+        self.positions = np.asarray(position, dtype=np.int32).reshape(-1)
+
+    @property
+    def num_queries(self) -> int:
+        return 0 if self.query_boundaries is None else len(self.query_boundaries) - 1
+
+
+class BinnedDataset:
+    """Pre-binned dataset (host arrays; `.device_bins()` ships to TPU).
+
+    Attributes:
+      bins_fm: [F_used, N] feature-major bin ids (uint8 or uint16).
+      mappers: BinMapper per used feature.
+      used_features: original column index per used feature.
+      num_total_features: raw feature count (incl. trivial/dropped).
+    """
+
+    def __init__(self, bins_fm: np.ndarray, mappers: List[BinMapper],
+                 used_features: List[int], num_total_features: int,
+                 metadata: Metadata, feature_names: Optional[List[str]] = None,
+                 label_idx: int = 0):
+        self.bins_fm = bins_fm
+        self.mappers = mappers
+        self.used_features = used_features
+        self.num_total_features = num_total_features
+        self.metadata = metadata
+        self.feature_names = feature_names or [
+            f"Column_{i}" for i in range(num_total_features)]
+        self.label_idx = label_idx
+        self._device_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------------
+    @property
+    def num_data(self) -> int:
+        return self.bins_fm.shape[1]
+
+    @property
+    def num_features(self) -> int:
+        return self.bins_fm.shape[0]
+
+    @property
+    def max_bins(self) -> int:
+        return max((m.num_bins for m in self.mappers), default=1)
+
+    def feature_meta_arrays(self):
+        """Host numpy arrays for ops.split.FeatureMeta."""
+        f = len(self.mappers)
+        num_bins = np.array([m.num_bins for m in self.mappers], np.int32)
+        missing = np.array([m.missing_type for m in self.mappers], np.int32)
+        default_bin = np.array([m.default_bin for m in self.mappers], np.int32)
+        is_cat = np.array([m.is_categorical for m in self.mappers], bool)
+        return num_bins, missing, default_bin, is_cat
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_matrix(cls, data: np.ndarray, config: Config,
+                    metadata: Optional[Metadata] = None,
+                    categorical_features: Sequence[int] = (),
+                    feature_names: Optional[List[str]] = None,
+                    reference: Optional["BinnedDataset"] = None,
+                    forced_bins: Optional[Dict[int, List[float]]] = None,
+                    ) -> "BinnedDataset":
+        """Bin a dense [N, F] float matrix (ref: DatasetLoader::
+        ConstructFromSampleData, src/io/dataset_loader.cpp:601)."""
+        data = np.asarray(data)
+        if data.ndim != 2:
+            raise ValueError("data must be 2-D [num_data, num_features]")
+        n, f = data.shape
+        metadata = metadata or Metadata(n)
+
+        if reference is not None:
+            # align binning with a reference (train) dataset
+            # (ref: dataset_loader.cpp:307 LoadFromFileAlignWithOtherDataset)
+            mappers = reference.mappers
+            used = reference.used_features
+            bins_fm = np.empty((len(used), n), dtype=reference.bins_fm.dtype)
+            for j, col in enumerate(used):
+                bins_fm[j] = mappers[j].transform(data[:, col])
+            return cls(bins_fm, mappers, used, reference.num_total_features,
+                       metadata, reference.feature_names)
+
+        # sample rows for binning (ref: bin_construct_sample_cnt)
+        sample_cnt = min(n, int(config.bin_construct_sample_cnt))
+        if sample_cnt < n:
+            rng = np.random.RandomState(config.data_random_seed)
+            sample_idx = rng.choice(n, sample_cnt, replace=False)
+            sample = data[np.sort(sample_idx)]
+        else:
+            sample = data
+
+        cat_set = set(int(c) for c in categorical_features)
+        mappers_all: List[BinMapper] = []
+        max_bin_by_feature = config.max_bin_by_feature
+        for col in range(f):
+            mb = int(config.max_bin)
+            if max_bin_by_feature is not None and len(max_bin_by_feature) == f:
+                mb = int(max_bin_by_feature[col])
+            forced = None
+            if forced_bins and col in forced_bins:
+                forced = forced_bins[col]
+            m = BinMapper().fit(
+                np.asarray(sample[:, col], dtype=np.float64),
+                max_bin=mb,
+                min_data_in_bin=int(config.min_data_in_bin),
+                use_missing=bool(config.use_missing),
+                zero_as_missing=bool(config.zero_as_missing),
+                is_categorical=col in cat_set,
+                forced_bounds=forced)
+            mappers_all.append(m)
+
+        used = [i for i, m in enumerate(mappers_all)
+                if not (config.feature_pre_filter and m.is_trivial)]
+        if not used:
+            used = [0] if f else []
+        mappers = [mappers_all[i] for i in used]
+        max_bins = max((m.num_bins for m in mappers), default=1)
+        dtype = np.uint8 if max_bins <= 256 else np.uint16
+        bins_fm = np.empty((len(used), n), dtype=dtype)
+        for j, col in enumerate(used):
+            bins_fm[j] = mappers[j].transform(data[:, col])
+        return cls(bins_fm, mappers, used, f, metadata, feature_names)
+
+    # ------------------------------------------------------------------
+    def device_bins(self):
+        """Bin matrix as a device array (cached)."""
+        import jax.numpy as jnp
+        key = "bins"
+        if key not in self._device_cache:
+            self._device_cache[key] = jnp.asarray(self.bins_fm)
+        return self._device_cache[key]
+
+    def feature_infos(self) -> List[str]:
+        """Per raw feature info strings for the model header."""
+        infos = []
+        used_map = {c: j for j, c in enumerate(self.used_features)}
+        for col in range(self.num_total_features):
+            if col in used_map:
+                infos.append(self.mappers[used_map[col]].feature_info_str())
+            else:
+                infos.append("none")
+        return infos

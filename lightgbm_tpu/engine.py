@@ -1,0 +1,220 @@
+"""Training / CV entry points (ref: python-package/lightgbm/engine.py:109
+train, :626 cv)."""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset, LightGBMError
+from .config import Config
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[List[Dataset]] = None,
+          valid_names: Optional[List[str]] = None,
+          feval=None, init_model=None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[List[Callable]] = None) -> Booster:
+    """(ref: engine.py:109)"""
+    params = dict(params or {})
+    cfg = Config.from_params(params)
+    if cfg.num_iterations != 100 and "num_boost_round" not in params:
+        num_boost_round = cfg.num_iterations
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        callbacks = list(callbacks or [])
+        callbacks.append(callback_mod.early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only,
+            verbose=cfg.verbosity > 0,
+            min_delta=cfg.early_stopping_min_delta))
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        raise LightGBMError(
+            "continued training (init_model) not yet supported in round 1")
+
+    valid_sets = valid_sets or []
+    valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]
+    is_valid_contain_train = False
+    train_data_name = "training"
+    for vs, name in zip(valid_sets, valid_names):
+        if vs is train_set:
+            is_valid_contain_train = True
+            train_data_name = name
+            continue
+        booster.add_valid(vs, name)
+
+    callbacks = list(callbacks or [])
+    callbacks_before = [cb for cb in callbacks
+                        if getattr(cb, "before_iteration", False)]
+    callbacks_after = [cb for cb in callbacks
+                       if not getattr(cb, "before_iteration", False)]
+    callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    booster.best_iteration = -1
+    for i in range(num_boost_round):
+        for cb in callbacks_before:
+            cb(callback_mod.CallbackEnv(
+                model=booster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=None))
+        should_stop = booster.update()
+
+        evaluation_result_list = []
+        if (valid_sets or cfg.is_provide_training_metric) and \
+                cfg.metric_freq > 0 and (i + 1) % cfg.metric_freq == 0:
+            if is_valid_contain_train or cfg.is_provide_training_metric:
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        try:
+            for cb in callbacks_after:
+                cb(callback_mod.CallbackEnv(
+                    model=booster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=evaluation_result_list))
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for item in e.best_score:
+                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
+            break
+        if should_stop:
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration()
+    return booster
+
+
+class CVBooster:
+    """Ensemble of per-fold boosters (ref: engine.py:299 CVBooster)."""
+
+    def __init__(self, model_file=None):
+        self.boosters: List[Booster] = []
+        self.best_iteration = -1
+
+    def append(self, booster: Booster) -> "CVBooster":
+        self.boosters.append(booster)
+        return self
+
+    def __getattr__(self, name):
+        def handler_function(*args, **kwargs):
+            return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
+        return handler_function
+
+
+def _make_n_folds(full_data: Dataset, nfold: int, params, seed: int,
+                  stratified: bool, shuffle: bool):
+    full_data.construct()
+    num_data = full_data.num_data()
+    rng = np.random.RandomState(seed)
+    label = np.asarray(full_data.label)
+    if stratified:
+        # stratified folds by label value
+        folds = [[] for _ in range(nfold)]
+        for val in np.unique(label):
+            idx = np.flatnonzero(label == val)
+            if shuffle:
+                rng.shuffle(idx)
+            for j, chunk in enumerate(np.array_split(idx, nfold)):
+                folds[j].extend(chunk.tolist())
+        test_indices = [np.asarray(sorted(f)) for f in folds]
+    else:
+        idx = np.arange(num_data)
+        if shuffle:
+            rng.shuffle(idx)
+        test_indices = [np.sort(chunk) for chunk in np.array_split(idx, nfold)]
+    for test_idx in test_indices:
+        train_idx = np.setdiff1d(np.arange(num_data), test_idx)
+        yield train_idx, test_idx
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True,
+       shuffle: bool = True, metrics=None, feval=None,
+       init_model=None, seed: int = 0,
+       callbacks: Optional[List[Callable]] = None,
+       eval_train_metric: bool = False,
+       return_cvbooster: bool = False) -> Dict[str, List[float]]:
+    """(ref: engine.py:626)"""
+    params = dict(params or {})
+    if metrics is not None:
+        params["metric"] = metrics
+    cfg = Config.from_params(params)
+    if cfg.num_iterations != 100 and "num_boost_round" not in params:
+        num_boost_round = cfg.num_iterations
+    if cfg.objective in ("binary", "multiclass", "multiclassova") \
+            and stratified is None:
+        stratified = True
+    if cfg.objective in ("lambdarank", "rank_xendcg"):
+        stratified = False
+
+    if folds is not None:
+        fold_iter = folds
+    else:
+        fold_iter = _make_n_folds(train_set, nfold, params, seed, stratified
+                                  and cfg.objective in
+                                  ("binary", "multiclass", "multiclassova"),
+                                  shuffle)
+
+    cvbooster = CVBooster()
+    fold_data = []
+    for train_idx, test_idx in fold_iter:
+        dtrain = train_set.subset(train_idx)
+        dvalid = train_set.subset(test_idx)
+        fold_data.append((dtrain, dvalid))
+
+    results: Dict[str, List[float]] = {}
+    boosters = []
+    for dtrain, dvalid in fold_data:
+        bst = Booster(params=params, train_set=dtrain)
+        bst.add_valid(dvalid, "valid")
+        boosters.append(bst)
+        cvbooster.append(bst)
+
+    cb_early = None
+    if cfg.early_stopping_round and cfg.early_stopping_round > 0:
+        cb_early = callback_mod.early_stopping(
+            cfg.early_stopping_round, cfg.first_metric_only,
+            verbose=cfg.verbosity > 0)
+
+    for i in range(num_boost_round):
+        all_results: Dict[str, List[float]] = {}
+        for bst in boosters:
+            bst.update()
+            res = bst.eval_valid(feval)
+            if eval_train_metric:
+                res = bst.eval_train(feval) + res
+            for name, metric, value, hib in res:
+                all_results.setdefault(f"{name} {metric}", []).append(value)
+                all_results.setdefault(f"__hib {name} {metric}", []).append(hib)
+        evaluation_result_list = []
+        for key, values in all_results.items():
+            if key.startswith("__hib"):
+                continue
+            hib = all_results[f"__hib {key}"][0]
+            mean, std = float(np.mean(values)), float(np.std(values))
+            results.setdefault(key + "-mean", []).append(mean)
+            results.setdefault(key + "-stdv", []).append(std)
+            evaluation_result_list.append(("cv_agg", key, mean, hib))
+        try:
+            env = callback_mod.CallbackEnv(
+                model=cvbooster, params=params, iteration=i,
+                begin_iteration=0, end_iteration=num_boost_round,
+                evaluation_result_list=evaluation_result_list)
+            if cb_early is not None:
+                cb_early(env)
+            for cb in (callbacks or []):
+                cb(env)
+        except callback_mod.EarlyStopException as e:
+            cvbooster.best_iteration = e.best_iteration + 1
+            for key in list(results.keys()):
+                results[key] = results[key][:cvbooster.best_iteration]
+            break
+
+    if return_cvbooster:
+        results["cvbooster"] = cvbooster
+    return results

@@ -1,0 +1,115 @@
+"""Text data loading: CSV / TSV / LibSVM auto-detect.
+
+(ref: src/io/parser.hpp:19,57,94 CSVParser/TSVParser/LibSVMParser and the
+format auto-detection in parser.cpp:261; sidecar `.weight` / `.query`
+files as in src/io/metadata.cpp LoadWeights/LoadQueryBoundaries.)
+
+A C-accelerated parser is planned under src/ (native runtime); this numpy
+path is the portable fallback.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _detect_format(first_lines: List[str]) -> str:
+    for line in first_lines:
+        if not line.strip():
+            continue
+        tokens = line.replace("\t", " ").split()
+        if any(":" in t for t in tokens[1:]):
+            return "libsvm"
+        if "\t" in line:
+            return "tsv"
+        if "," in line:
+            return "csv"
+    return "tsv"
+
+
+def load_svmlight_or_csv(path: str, params: Dict
+                         ) -> Tuple[np.ndarray, Optional[np.ndarray],
+                                    Optional[np.ndarray],
+                                    Optional[np.ndarray]]:
+    """Returns (data [N, F], label [N], weight or None, group sizes or None).
+
+    Label column defaults to column 0 (ref: config label_column).
+    """
+    has_header = str(params.get("header", params.get("has_header", "false"))
+                     ).lower() in ("true", "1")
+    label_column = params.get("label_column", params.get("label", ""))
+
+    with open(path) as fh:
+        lines = [ln.rstrip("\n") for ln in fh]
+    lines = [ln for ln in lines if ln.strip()]
+    if not lines:
+        raise ValueError(f"empty data file: {path}")
+    fmt = _detect_format(lines[:10])
+
+    header_names: Optional[List[str]] = None
+    if has_header and fmt in ("csv", "tsv"):
+        sep = "," if fmt == "csv" else "\t"
+        header_names = lines[0].split(sep)
+        lines = lines[1:]
+
+    label_idx = 0
+    if isinstance(label_column, str) and label_column.startswith("name:"):
+        name = label_column[5:]
+        if header_names and name in header_names:
+            label_idx = header_names.index(name)
+    elif str(label_column).isdigit():
+        label_idx = int(label_column)
+
+    if fmt == "libsvm":
+        labels = np.empty(len(lines), np.float64)
+        rows: List[Dict[int, float]] = []
+        max_feat = -1
+        for i, line in enumerate(lines):
+            toks = line.replace("\t", " ").split()
+            labels[i] = float(toks[0])
+            row = {}
+            for t in toks[1:]:
+                if ":" not in t:
+                    continue
+                k, v = t.split(":", 1)
+                k = int(k)
+                row[k] = float(v)
+                max_feat = max(max_feat, k)
+            rows.append(row)
+        data = np.zeros((len(lines), max_feat + 1), np.float64)
+        for i, row in enumerate(rows):
+            for k, v in row.items():
+                data[i, k] = v
+        label = labels
+    else:
+        sep = "," if fmt == "csv" else "\t"
+        mat = np.array(
+            [[_parse_float(x) for x in ln.split(sep)] for ln in lines],
+            dtype=np.float64)
+        label = mat[:, label_idx].copy()
+        data = np.delete(mat, label_idx, axis=1)
+
+    weight = None
+    wfile = path + ".weight"
+    if os.path.exists(wfile):
+        weight = np.loadtxt(wfile, dtype=np.float64).reshape(-1)
+
+    group = None
+    qfile = path + ".query"
+    if os.path.exists(qfile):
+        group = np.loadtxt(qfile, dtype=np.int64).reshape(-1)
+
+    return data, label, weight, group
+
+
+def _parse_float(tok: str) -> float:
+    tok = tok.strip()
+    if not tok or tok.lower() in ("na", "nan", "null", "none", "?"):
+        return float("nan")
+    try:
+        return float(tok)
+    except ValueError:
+        return float("nan")

@@ -1,0 +1,249 @@
+"""Serial TPU tree learner — the jitted leaf-wise tree grower.
+
+TPU-native re-architecture of the reference learners
+(ref: src/treelearner/serial_tree_learner.cpp:183 Train,
+src/treelearner/cuda/cuda_single_gpu_tree_learner.cpp:170). The
+``num_leaves - 1`` best-first splits become a single ``lax.scan`` with
+fixed trip count; all state (row->leaf map, histogram pool, per-leaf best
+splits) has static shapes, so the whole tree grows inside one XLA program
+with no host round-trips (the CUDA learner pays one readback per split).
+
+Key correspondences:
+  - histogram pool  ~ HistogramPool (serial_tree_learner.cpp:40)
+  - smaller-child build + sibling subtraction ~ serial_tree_learner.cpp:373,582
+  - per-leaf best-split arrays ~ best_split_per_leaf_
+  - row_leaf vector ~ CUDADataPartition's cuda_data_index_to_leaf_index_
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .ops import histogram as hist_ops
+from .ops import partition as part_ops
+from .ops import split as split_ops
+from .ops.histogram import COUNT, GRAD, HESS
+from .ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams, SplitInfo,
+                        find_best_split, leaf_output)
+
+
+class TreeArrays(NamedTuple):
+    """One grown tree, flat arrays (device). L = num_leaves slots.
+
+    Splits are recorded in creation order: split s creates internal node s;
+    its left child keeps leaf id `split_leaf[s]`, its right child is the new
+    leaf id ``s + 1`` (the reference uses the same numbering,
+    ref: src/io/tree.cpp Tree::Split).
+    """
+    split_leaf: jax.Array          # [L-1] int32, -1 when unused
+    split_feature: jax.Array       # [L-1] int32
+    split_bin_threshold: jax.Array  # [L-1] int32
+    split_default_left: jax.Array  # [L-1] bool
+    split_gain: jax.Array          # [L-1] f32
+    internal_value: jax.Array      # [L-1] f32 (unshrunk output of split node)
+    internal_weight: jax.Array     # [L-1] f32 (sum_hess)
+    internal_count: jax.Array      # [L-1] f32
+    leaf_value: jax.Array          # [L] f32 (unshrunk)
+    leaf_weight: jax.Array         # [L] f32
+    leaf_count: jax.Array          # [L] f32
+    num_leaves: jax.Array          # scalar int32
+
+
+class _LeafSplits(NamedTuple):
+    """Per-leaf stats + stored best split (ref: leaf_splits.hpp:23 +
+    best_split_per_leaf_ in serial_tree_learner.h)."""
+    sum_grad: jax.Array   # [L]
+    sum_hess: jax.Array   # [L]
+    count: jax.Array      # [L]
+    depth: jax.Array      # [L] int32
+    gain: jax.Array       # [L]
+    feature: jax.Array    # [L] int32
+    threshold: jax.Array  # [L] int32
+    default_left: jax.Array  # [L] bool
+    left_sum_grad: jax.Array
+    left_sum_hess: jax.Array
+    left_count: jax.Array
+
+
+class _GrowState(NamedTuple):
+    row_leaf: jax.Array   # [N] int32
+    pool: jax.Array       # [L, F, B, 3] histogram pool
+    leaves: _LeafSplits
+
+
+def _store_split(leaves: _LeafSplits, idx, info: SplitInfo, depth,
+                 sum_grad, sum_hess, count, valid) -> _LeafSplits:
+    """Write one leaf's stats + its best candidate split at slot `idx`."""
+    def upd(arr, val):
+        return arr.at[idx].set(jnp.where(valid, val, arr[idx]))
+    return _LeafSplits(
+        sum_grad=upd(leaves.sum_grad, sum_grad),
+        sum_hess=upd(leaves.sum_hess, sum_hess),
+        count=upd(leaves.count, count),
+        depth=upd(leaves.depth, depth),
+        gain=upd(leaves.gain, info.gain),
+        feature=upd(leaves.feature, info.feature),
+        threshold=upd(leaves.threshold, info.threshold),
+        default_left=upd(leaves.default_left, info.default_left),
+        left_sum_grad=upd(leaves.left_sum_grad, info.left_sum_grad),
+        left_sum_hess=upd(leaves.left_sum_hess, info.left_sum_hess),
+        left_count=upd(leaves.left_count, info.left_count),
+    )
+
+
+def grow_tree(bins_fm: jax.Array,
+              grad: jax.Array,
+              hess: jax.Array,
+              sample_mask: jax.Array,
+              feature_mask: jax.Array,
+              meta: FeatureMeta,
+              hp: SplitHyperParams,
+              max_depth: jax.Array,
+              *,
+              num_leaves: int,
+              max_bins: int,
+              hist_dtype=jnp.float32,
+              row_chunk: int = 0):
+    """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
+
+    sample_mask: [N] float {0,1} bagging/GOSS selection (excluded rows still
+    get a leaf assignment for score updates, but contribute no statistics —
+    ref: bagging keeps full score updates, gbdt.cpp:502).
+    """
+    num_data = bins_fm.shape[1]
+    num_features = bins_fm.shape[0]
+    L = num_leaves
+    f32 = hist_dtype
+
+    build = functools.partial(hist_ops.build_histogram, max_bins=max_bins,
+                              dtype=f32, row_chunk=row_chunk)
+
+    # --- root (ref: serial_tree_learner.cpp BeforeTrain root LeafSplits init)
+    root_hist = build(bins_fm, grad, hess, sample_mask)
+    root_g = jnp.sum(grad * sample_mask, dtype=f32)
+    root_h = jnp.sum(hess * sample_mask, dtype=f32)
+    root_c = jnp.sum(sample_mask, dtype=f32)
+    root_split = find_best_split(root_hist, root_g, root_h, root_c,
+                                 meta, hp, feature_mask)
+
+    zero_l = jnp.zeros((L,), f32)
+    leaves = _LeafSplits(
+        sum_grad=zero_l, sum_hess=zero_l, count=zero_l,
+        depth=jnp.zeros((L,), jnp.int32),
+        gain=jnp.full((L,), K_MIN_SCORE, f32),
+        feature=jnp.zeros((L,), jnp.int32),
+        threshold=jnp.zeros((L,), jnp.int32),
+        default_left=jnp.zeros((L,), jnp.bool_),
+        left_sum_grad=zero_l, left_sum_hess=zero_l, left_count=zero_l,
+    )
+    leaves = _store_split(leaves, 0, root_split, jnp.int32(1),
+                          root_g, root_h, root_c, True)
+
+    pool = jnp.zeros((L, num_features, max_bins, hist_ops.NUM_HIST_CHANNELS),
+                     f32)
+    pool = pool.at[0].set(root_hist)
+
+    state = _GrowState(
+        row_leaf=jnp.zeros((num_data,), jnp.int32),
+        pool=pool,
+        leaves=leaves,
+    )
+
+    def step(state: _GrowState, step_idx):
+        leaves = state.leaves
+        best_leaf = jnp.argmax(leaves.gain).astype(jnp.int32)
+        valid = leaves.gain[best_leaf] > 0.0
+        new_leaf = (step_idx + 1).astype(jnp.int32)
+
+        feat = leaves.feature[best_leaf]
+        thr = leaves.threshold[best_leaf]
+        dleft = leaves.default_left[best_leaf]
+
+        # --- partition rows (left keeps best_leaf id, right -> new_leaf)
+        row_leaf = part_ops.apply_split(
+            state.row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
+            meta.num_bins, meta.missing_type, valid)
+
+        # --- children stats from the stored candidate
+        lg = leaves.left_sum_grad[best_leaf]
+        lh = leaves.left_sum_hess[best_leaf]
+        lc = leaves.left_count[best_leaf]
+        pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
+                      leaves.count[best_leaf])
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+
+        # --- histograms: build smaller child, subtract for the sibling
+        # (ref: serial_tree_learner.cpp:373-386,582)
+        left_smaller = lc <= rc
+        small_id = jnp.where(left_smaller, best_leaf, new_leaf)
+        small_mask = sample_mask * (row_leaf == small_id) * valid
+        small_hist = build(bins_fm, grad, hess, small_mask)
+        parent_hist = state.pool[best_leaf]
+        large_hist = hist_ops.subtract_histogram(parent_hist, small_hist)
+        left_hist = jnp.where(left_smaller, small_hist, large_hist)
+        right_hist = jnp.where(left_smaller, large_hist, small_hist)
+
+        pool = state.pool
+        pool = pool.at[best_leaf].set(jnp.where(valid, left_hist, parent_hist))
+        pool = pool.at[new_leaf].set(
+            jnp.where(valid, right_hist, pool[new_leaf]))
+
+        # --- find child best splits
+        child_depth = leaves.depth[best_leaf] + 1
+        split_l = find_best_split(left_hist, lg, lh, lc, meta, hp, feature_mask)
+        split_r = find_best_split(right_hist, rg, rh, rc, meta, hp,
+                                  feature_mask)
+        # depth cap (ref: serial_tree_learner.cpp max_depth check)
+        depth_ok = (max_depth <= 0) | (child_depth < max_depth)
+        split_l = split_l._replace(
+            gain=jnp.where(depth_ok, split_l.gain, K_MIN_SCORE))
+        split_r = split_r._replace(
+            gain=jnp.where(depth_ok, split_r.gain, K_MIN_SCORE))
+
+        leaves = _store_split(leaves, best_leaf, split_l, child_depth,
+                              lg, lh, lc, valid)
+        leaves = _store_split(leaves, new_leaf, split_r, child_depth,
+                              rg, rh, rc, valid)
+
+        record = dict(
+            split_leaf=jnp.where(valid, best_leaf, -1),
+            split_feature=feat,
+            split_bin_threshold=thr,
+            split_default_left=dleft,
+            split_gain=jnp.where(valid, leaves.gain[best_leaf], 0.0),
+            internal_value=leaf_output(pg, ph, hp),
+            internal_weight=ph,
+            internal_count=pc,
+        )
+        # note: split_gain above reads the *updated* leaves at best_leaf (the
+        # left child's gain) — record the parent's chosen gain instead:
+        record["split_gain"] = jnp.where(valid, state.leaves.gain[best_leaf],
+                                         0.0)
+        return _GrowState(row_leaf, pool, leaves), record
+
+    state, records = lax.scan(step, state, jnp.arange(L - 1, dtype=jnp.int32))
+
+    leaves = state.leaves
+    leaf_values = leaf_output(leaves.sum_grad, leaves.sum_hess, hp)
+    num_leaves_out = 1 + jnp.sum(records["split_leaf"] >= 0).astype(jnp.int32)
+
+    tree = TreeArrays(
+        split_leaf=records["split_leaf"],
+        split_feature=records["split_feature"],
+        split_bin_threshold=records["split_bin_threshold"],
+        split_default_left=records["split_default_left"],
+        split_gain=records["split_gain"],
+        internal_value=records["internal_value"],
+        internal_weight=records["internal_weight"],
+        internal_count=records["internal_count"],
+        leaf_value=leaf_values,
+        leaf_weight=leaves.sum_hess,
+        leaf_count=leaves.count,
+        num_leaves=num_leaves_out,
+    )
+    return tree, state.row_leaf

@@ -1,0 +1,418 @@
+"""Evaluation metrics.
+
+Re-implementation of the reference metric layer
+(ref: src/metric/metric.cpp:22 factory; regression_metric.hpp,
+binary_metric.hpp, multiclass_metric.hpp, rank_metric.hpp,
+xentropy_metric.hpp, dcg_calculator.cpp). Metrics run on host numpy over
+raw scores pulled back once per eval round (the reference evaluates on CPU
+as well). Each metric returns (name, value, is_higher_better).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from .config import Config
+from .dataset import Metadata
+
+
+class Metric:
+    name = "none"
+    is_higher_better = False
+
+    def __init__(self, config: Config):
+        self.config = config
+
+    def init(self, metadata: Metadata, num_data: int) -> None:
+        self.metadata = metadata
+        self.num_data = num_data
+        self.label = metadata.label if metadata.label is not None else \
+            np.zeros(num_data, np.float32)
+        self.weight = metadata.weight
+        self.sum_weight = (float(np.sum(self.weight))
+                           if self.weight is not None else float(num_data))
+
+    def _avg(self, values: np.ndarray) -> float:
+        if self.weight is not None:
+            return float(np.sum(values * self.weight) / self.sum_weight)
+        return float(np.mean(values))
+
+    def eval(self, prob: np.ndarray, raw: np.ndarray) -> List[Tuple[str, float, bool]]:
+        """prob: objective-converted output; raw: raw scores."""
+        raise NotImplementedError
+
+
+# --- regression (ref: src/metric/regression_metric.hpp) -------------------
+class _PointwiseMetric(Metric):
+    def point_loss(self, label, pred):
+        raise NotImplementedError
+
+    def transform(self, value: float) -> float:
+        return value
+
+    def eval(self, prob, raw):
+        v = self.transform(self._avg(self.point_loss(self.label, prob)))
+        return [(self.name, v, self.is_higher_better)]
+
+
+class L2Metric(_PointwiseMetric):
+    name = "l2"
+
+    def point_loss(self, label, pred):
+        return (label - pred) ** 2
+
+
+class RMSEMetric(L2Metric):
+    name = "rmse"
+
+    def transform(self, value):
+        return float(np.sqrt(value))
+
+
+class L1Metric(_PointwiseMetric):
+    name = "l1"
+
+    def point_loss(self, label, pred):
+        return np.abs(label - pred)
+
+
+class QuantileMetric(_PointwiseMetric):
+    name = "quantile"
+
+    def point_loss(self, label, pred):
+        a = self.config.alpha
+        d = label - pred
+        return np.where(d >= 0, a * d, (a - 1.0) * d)
+
+
+class HuberMetric(_PointwiseMetric):
+    name = "huber"
+
+    def point_loss(self, label, pred):
+        a = self.config.alpha
+        d = np.abs(label - pred)
+        return np.where(d <= a, 0.5 * d * d, a * (d - 0.5 * a))
+
+
+class FairMetric(_PointwiseMetric):
+    name = "fair"
+
+    def point_loss(self, label, pred):
+        c = self.config.fair_c
+        x = np.abs(label - pred)
+        return c * x - c * c * np.log1p(x / c)
+
+
+class PoissonMetric(_PointwiseMetric):
+    name = "poisson"
+
+    def point_loss(self, label, pred):
+        eps = 1e-10
+        return pred - label * np.log(np.maximum(pred, eps))
+
+
+class MAPEMetric(_PointwiseMetric):
+    name = "mape"
+
+    def point_loss(self, label, pred):
+        return np.abs((label - pred) / np.maximum(1.0, np.abs(label)))
+
+
+class GammaMetric(_PointwiseMetric):
+    """Gamma negative log-likelihood with psi = 1
+    (ref: regression_metric.hpp GammaMetric)."""
+    name = "gamma"
+
+    def point_loss(self, label, pred):
+        eps = 1e-10
+        p = np.maximum(pred, eps)
+        lab = np.maximum(label, eps)
+        # -log L = y/mu + log(mu) - log(y)   (unit shape)
+        return lab / p + np.log(p) - np.log(lab)
+
+
+class GammaDevianceMetric(_PointwiseMetric):
+    name = "gamma_deviance"
+
+    def point_loss(self, label, pred):
+        eps = 1e-10
+        f = label / np.maximum(pred, eps)
+        return 2.0 * (f - np.log(np.maximum(f, eps)) - 1.0)
+
+
+class TweedieMetric(_PointwiseMetric):
+    name = "tweedie"
+
+    def point_loss(self, label, pred):
+        rho = self.config.tweedie_variance_power
+        eps = 1e-10
+        p = np.maximum(pred, eps)
+        a = label * np.power(p, 1.0 - rho) / (1.0 - rho)
+        b = np.power(p, 2.0 - rho) / (2.0 - rho)
+        return -a + b
+
+
+class R2Metric(Metric):
+    name = "r2"
+    is_higher_better = True
+
+    def eval(self, prob, raw):
+        w = self.weight if self.weight is not None else np.ones_like(self.label)
+        mean = np.sum(self.label * w) / np.sum(w)
+        ss_res = np.sum(w * (self.label - prob) ** 2)
+        ss_tot = np.sum(w * (self.label - mean) ** 2)
+        return [(self.name, float(1.0 - ss_res / max(ss_tot, 1e-300)), True)]
+
+
+# --- binary (ref: src/metric/binary_metric.hpp) ---------------------------
+class BinaryLoglossMetric(_PointwiseMetric):
+    name = "binary_logloss"
+
+    def point_loss(self, label, pred):
+        eps = 1e-15
+        p = np.clip(pred, eps, 1.0 - eps)
+        y = (label > 0).astype(np.float64)
+        return -(y * np.log(p) + (1.0 - y) * np.log(1.0 - p))
+
+
+class BinaryErrorMetric(_PointwiseMetric):
+    name = "binary_error"
+
+    def point_loss(self, label, pred):
+        y = (label > 0).astype(np.float64)
+        return ((pred > 0.5) != (y > 0)).astype(np.float64)
+
+
+def _auc(label, prob, weight=None) -> float:
+    """Weighted ROC-AUC by rank-sum (ref: binary_metric.hpp AUCMetric)."""
+    y = (label > 0)
+    w = weight if weight is not None else np.ones(len(label))
+    order = np.argsort(prob, kind="mergesort")
+    p_s, y_s, w_s = prob[order], y[order], w[order]
+    # tie-aware trapezoid accumulation
+    pos_w = np.where(y_s, w_s, 0.0)
+    neg_w = np.where(~y_s, w_s, 0.0)
+    # group by distinct prob values
+    boundaries = np.nonzero(np.diff(p_s))[0]
+    idx = np.concatenate([boundaries, [len(p_s) - 1]])
+    cpos = np.cumsum(pos_w)[idx]
+    cneg = np.cumsum(neg_w)[idx]
+    gpos = np.diff(np.concatenate([[0.0], cpos]))
+    gneg = np.diff(np.concatenate([[0.0], cneg]))
+    prev_neg = np.concatenate([[0.0], cneg[:-1]])
+    area = np.sum(gpos * (prev_neg + gneg * 0.5))
+    tot_pos, tot_neg = cpos[-1], cneg[-1]
+    if tot_pos <= 0 or tot_neg <= 0:
+        return 0.5
+    return float(area / (tot_pos * tot_neg))
+
+
+class AUCMetric(Metric):
+    name = "auc"
+    is_higher_better = True
+
+    def eval(self, prob, raw):
+        return [(self.name, _auc(self.label, prob, self.weight), True)]
+
+
+class AveragePrecisionMetric(Metric):
+    name = "average_precision"
+    is_higher_better = True
+
+    def eval(self, prob, raw):
+        w = self.weight if self.weight is not None else np.ones(len(self.label))
+        order = np.argsort(-prob, kind="mergesort")
+        y = (self.label[order] > 0)
+        ws = w[order]
+        tp = np.cumsum(ws * y)
+        fp = np.cumsum(ws * ~y)
+        precision = tp / np.maximum(tp + fp, 1e-300)
+        dtp = np.diff(np.concatenate([[0.0], tp]))
+        total_pos = tp[-1]
+        if total_pos <= 0:
+            return [(self.name, 0.0, True)]
+        return [(self.name, float(np.sum(precision * dtp) / total_pos), True)]
+
+
+# --- multiclass (ref: src/metric/multiclass_metric.hpp) -------------------
+class MultiLoglossMetric(Metric):
+    name = "multi_logloss"
+
+    def eval(self, prob, raw):
+        eps = 1e-15
+        y = self.label.astype(int)
+        p = np.clip(prob[np.arange(len(y)), y], eps, 1.0)
+        losses = -np.log(p)
+        return [(self.name, self._avg(losses), False)]
+
+
+class MultiErrorMetric(Metric):
+    name = "multi_error"
+
+    def eval(self, prob, raw):
+        y = self.label.astype(int)
+        k = self.config.multi_error_top_k
+        if k <= 1:
+            err = (np.argmax(prob, axis=1) != y).astype(np.float64)
+        else:
+            ranks = np.argsort(-prob, axis=1)[:, :k]
+            err = (~np.any(ranks == y[:, None], axis=1)).astype(np.float64)
+        return [(self.name, self._avg(err), False)]
+
+
+class AucMuMetric(Metric):
+    """Multi-class AUC-mu (ref: multiclass_metric.hpp auc_mu)."""
+    name = "auc_mu"
+    is_higher_better = True
+
+    def eval(self, prob, raw):
+        y = self.label.astype(int)
+        k = prob.shape[1]
+        aucs = []
+        for i in range(k):
+            for j in range(i + 1, k):
+                sel = (y == i) | (y == j)
+                if not np.any(y[sel] == i) or not np.any(y[sel] == j):
+                    continue
+                # decision score: prob difference as 1-D discriminant
+                s = prob[sel, i] - prob[sel, j]
+                aucs.append(_auc((y[sel] == i).astype(np.float32), s))
+        v = float(np.mean(aucs)) if aucs else 0.5
+        return [(self.name, v, True)]
+
+
+# --- cross-entropy (ref: src/metric/xentropy_metric.hpp) ------------------
+class CrossEntropyMetric(_PointwiseMetric):
+    name = "cross_entropy"
+
+    def point_loss(self, label, pred):
+        eps = 1e-15
+        p = np.clip(pred, eps, 1.0 - eps)
+        return -(label * np.log(p) + (1.0 - label) * np.log(1.0 - p))
+
+
+class CrossEntropyLambdaMetric(Metric):
+    name = "cross_entropy_lambda"
+
+    def eval(self, prob, raw):
+        # prob here = log1p(exp(raw)) from the objective's convert_output
+        eps = 1e-15
+        hhat = np.maximum(prob, eps)
+        loss = hhat - self.label * np.log(np.maximum(hhat, eps))
+        return [(self.name, self._avg(loss), False)]
+
+
+class KLDivMetric(Metric):
+    name = "kldiv"
+
+    def eval(self, prob, raw):
+        eps = 1e-15
+        p = np.clip(prob, eps, 1.0 - eps)
+        y = np.clip(self.label, eps, 1.0 - eps)
+        kl = (y * np.log(y / p) + (1.0 - y) * np.log((1.0 - y) / (1.0 - p)))
+        return [(self.name, self._avg(kl), False)]
+
+
+# --- ranking (ref: src/metric/rank_metric.hpp, dcg_calculator.cpp) --------
+class NDCGMetric(Metric):
+    name = "ndcg"
+    is_higher_better = True
+
+    def init(self, metadata, num_data):
+        super().init(metadata, num_data)
+        if metadata.query_boundaries is None:
+            raise ValueError("ndcg metric requires query data")
+        gains = self.config.label_gain
+        if gains is None:
+            max_label = int(self.label.max()) if num_data else 0
+            gains = [(1 << i) - 1 for i in range(max(max_label + 1, 2))]
+        self.label_gain = np.asarray(gains, np.float64)
+
+    def _dcg_at(self, labels, order, k):
+        top = order[:k]
+        gains = self.label_gain[labels[top].astype(int)]
+        return np.sum(gains / np.log2(np.arange(len(top)) + 2.0))
+
+    def eval(self, prob, raw):
+        qb = self.metadata.query_boundaries
+        ks = self.config.eval_at
+        sums = np.zeros(len(ks))
+        cnt = 0
+        for q in range(len(qb) - 1):
+            s, e = qb[q], qb[q + 1]
+            lab = self.label[s:e]
+            sc = raw[s:e]
+            order = np.argsort(-sc, kind="mergesort")
+            ideal = np.argsort(-lab, kind="mergesort")
+            for ki, k in enumerate(ks):
+                idcg = self._dcg_at(lab, ideal, k)
+                if idcg > 0:
+                    sums[ki] += self._dcg_at(lab, order, k) / idcg
+                else:
+                    sums[ki] += 1.0
+            cnt += 1
+        return [(f"ndcg@{k}", float(sums[i] / max(cnt, 1)), True)
+                for i, k in enumerate(ks)]
+
+
+class MAPMetric(Metric):
+    name = "map"
+    is_higher_better = True
+
+    def eval(self, prob, raw):
+        qb = self.metadata.query_boundaries
+        if qb is None:
+            raise ValueError("map metric requires query data")
+        ks = self.config.eval_at
+        sums = np.zeros(len(ks))
+        cnt = 0
+        for q in range(len(qb) - 1):
+            s, e = qb[q], qb[q + 1]
+            rel = (self.label[s:e] > 0)
+            order = np.argsort(-raw[s:e], kind="mergesort")
+            rel_sorted = rel[order]
+            hits = np.cumsum(rel_sorted)
+            prec = hits / (np.arange(len(rel_sorted)) + 1.0)
+            for ki, k in enumerate(ks):
+                topk = rel_sorted[:k]
+                npos = topk.sum()
+                if npos > 0:
+                    sums[ki] += np.sum(prec[:k] * topk) / npos
+            cnt += 1
+        return [(f"map@{k}", float(sums[i] / max(cnt, 1)), True)
+                for i, k in enumerate(ks)]
+
+
+# ---------------------------------------------------------------------------
+_METRICS = {
+    "l1": L1Metric, "l2": L2Metric, "rmse": RMSEMetric,
+    "quantile": QuantileMetric, "huber": HuberMetric, "fair": FairMetric,
+    "poisson": PoissonMetric, "mape": MAPEMetric,
+    "gamma": GammaMetric, "gamma_deviance": GammaDevianceMetric,
+    "tweedie": TweedieMetric, "r2": R2Metric,
+    "binary_logloss": BinaryLoglossMetric, "binary_error": BinaryErrorMetric,
+    "auc": AUCMetric, "average_precision": AveragePrecisionMetric,
+    "multi_logloss": MultiLoglossMetric, "multi_error": MultiErrorMetric,
+    "auc_mu": AucMuMetric,
+    "cross_entropy": CrossEntropyMetric,
+    "cross_entropy_lambda": CrossEntropyLambdaMetric,
+    "kldiv": KLDivMetric,
+    "ndcg": NDCGMetric, "map": MAPMetric,
+}
+
+
+def create_metrics(config: Config, names: Optional[List[str]] = None
+                   ) -> List[Metric]:
+    """Factory (ref: Metric::CreateMetric, src/metric/metric.cpp:22)."""
+    names = names if names is not None else config.metric
+    out = []
+    for n in names:
+        if n in ("none", ""):
+            continue
+        cls = _METRICS.get(n)
+        if cls is None:
+            raise ValueError(f"Unknown metric: {n}")
+        out.append(cls(config))
+    return out

@@ -1,0 +1,251 @@
+"""Model text/JSON serialization, LightGBM-format compatible.
+
+(ref: src/boosting/gbdt_model_text.cpp:315 SaveModelToString, :425
+LoadModelFromString). The emitted format round-trips through this module
+and follows the reference layout (`tree_sizes=` byte index, per-tree
+blocks, `end of trees`, feature importances, parameters block) so models
+can be inspected / consumed by reference tooling.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional
+
+import numpy as np
+
+from .config import Config
+from .tree import Tree
+
+
+def _objective_string(config: Config) -> str:
+    obj = config.objective
+    if obj == "binary":
+        return f"binary sigmoid:{config.sigmoid:g}"
+    if obj in ("multiclass", "multiclassova"):
+        return f"{obj} num_class:{config.num_class}"
+    if obj in ("lambdarank", "rank_xendcg"):
+        return obj
+    if obj == "quantile":
+        return f"quantile alpha:{config.alpha:g}"
+    if obj == "huber":
+        return f"huber alpha:{config.alpha:g}"
+    if obj == "fair":
+        return f"fair c:{config.fair_c:g}"
+    if obj == "tweedie":
+        return f"tweedie tweedie_variance_power:{config.tweedie_variance_power:g}"
+    return obj or "custom"
+
+
+def save_model_to_string(booster, num_iteration: int = -1,
+                         start_iteration: int = 0,
+                         importance_type: str = "split") -> str:
+    """booster: boosting.GBDT."""
+    cfg = booster.config
+    ds = booster.train_set
+    end = len(booster.models) if num_iteration < 0 else min(
+        len(booster.models), start_iteration + num_iteration)
+
+    header = ["tree", "version=v4"]
+    header.append(f"num_class={max(cfg.num_class, 1)}")
+    header.append(f"num_tree_per_iteration={booster.num_tree_per_iteration}")
+    header.append(f"label_index={ds.label_idx}")
+    header.append(f"max_feature_idx={ds.num_total_features - 1}")
+    header.append(f"objective={_objective_string(cfg)}")
+    if getattr(booster, "_average_output", False) or \
+            booster.boosting_type == "rf":
+        header.append("average_output")
+    header.append("feature_names=" + " ".join(ds.feature_names))
+    header.append("feature_infos=" + " ".join(ds.feature_infos()))
+
+    tree_blocks: List[str] = []
+    idx = 0
+    for it in range(start_iteration, end):
+        for tree in booster.models[it]:
+            tree_blocks.append(tree.to_string(idx) + "\n")
+            idx += 1
+    tree_sizes = " ".join(str(len(b.encode())) for b in tree_blocks)
+    header.append(f"tree_sizes={tree_sizes}")
+    header.append("")
+
+    out = "\n".join(header) + "\n" + "".join(tree_blocks)
+    out += "end of trees\n\n"
+
+    imp = booster.feature_importance(importance_type)
+    order = np.argsort(-imp, kind="stable")
+    lines = ["feature_importances:"]
+    for i in order:
+        if imp[i] > 0:
+            lines.append(f"{ds.feature_names[i]}={imp[i]:g}")
+    out += "\n".join(lines) + "\n\n"
+
+    out += "parameters:\n"
+    for key, value in cfg.to_params().items():
+        if isinstance(value, list):
+            value = ",".join(str(v) for v in value)
+        out += f"[{key}: {value}]\n"
+    out += "end of parameters\n\n"
+    out += "pandas_categorical:null\n"
+    return out
+
+
+class LoadedModel:
+    """A model parsed from text — enough state to predict and continue
+    inspection (ref: GBDT::LoadModelFromString gbdt_model_text.cpp:425)."""
+
+    def __init__(self):
+        self.trees: List[Tree] = []
+        self.num_class = 1
+        self.num_tree_per_iteration = 1
+        self.objective_str = "regression"
+        self.feature_names: List[str] = []
+        self.feature_infos: List[str] = []
+        self.max_feature_idx = 0
+        self.average_output = False
+        self.params = {}
+        self.label_index = 0
+
+    @property
+    def num_iterations(self) -> int:
+        if self.num_tree_per_iteration <= 0:
+            return len(self.trees)
+        return len(self.trees) // self.num_tree_per_iteration
+
+    def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+        data = np.asarray(data, np.float64)
+        n = data.shape[0]
+        k = self.num_tree_per_iteration
+        out = np.zeros((n, k))
+        end = self.num_iterations if num_iteration < 0 else min(
+            self.num_iterations, start_iteration + num_iteration)
+        for it in range(start_iteration, end):
+            for ki in range(k):
+                tree = self.trees[it * k + ki]
+                out[:, ki] += tree.predict(data)
+        if self.average_output and end > start_iteration:
+            out /= (end - start_iteration)
+        return out
+
+    def predict(self, data: np.ndarray, raw_score: bool = False,
+                **kwargs) -> np.ndarray:
+        raw = self.predict_raw(data, **kwargs)
+        if raw.shape[1] == 1:
+            raw = raw[:, 0]
+        if raw_score:
+            return raw
+        obj = self.objective_str.split()[0] if self.objective_str else ""
+        if obj == "binary":
+            sig = 1.0
+            for tok in self.objective_str.split()[1:]:
+                if tok.startswith("sigmoid:"):
+                    sig = float(tok.split(":")[1])
+            return 1.0 / (1.0 + np.exp(-sig * raw))
+        if obj == "multiclass":
+            e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+            return e / e.sum(axis=-1, keepdims=True)
+        if obj == "multiclassova":
+            return 1.0 / (1.0 + np.exp(-raw))
+        if obj in ("poisson", "gamma", "tweedie"):
+            return np.exp(raw)
+        if obj == "cross_entropy":
+            return 1.0 / (1.0 + np.exp(-raw))
+        return raw
+
+
+def load_model_from_string(text: str) -> LoadedModel:
+    model = LoadedModel()
+    lines = text.split("\n")
+    i = 0
+    # header
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if line.startswith("Tree=") or line == "end of trees":
+            i -= 1
+            break
+        if "=" in line:
+            key, value = line.split("=", 1)
+            if key == "num_class":
+                model.num_class = int(value)
+            elif key == "num_tree_per_iteration":
+                model.num_tree_per_iteration = int(value)
+            elif key == "label_index":
+                model.label_index = int(value)
+            elif key == "max_feature_idx":
+                model.max_feature_idx = int(value)
+            elif key == "objective":
+                model.objective_str = value
+            elif key == "feature_names":
+                model.feature_names = value.split()
+            elif key == "feature_infos":
+                model.feature_infos = value.split()
+        elif line == "average_output":
+            model.average_output = True
+
+    # tree blocks
+    block: List[str] = []
+    while i < len(lines):
+        line = lines[i]
+        i += 1
+        stripped = line.strip()
+        if stripped.startswith("Tree=") and block:
+            model.trees.append(Tree.from_string("\n".join(block)))
+            block = [stripped]
+        elif stripped == "end of trees":
+            if block:
+                model.trees.append(Tree.from_string("\n".join(block)))
+                block = []
+            break
+        elif stripped:
+            block.append(stripped)
+    if block:
+        model.trees.append(Tree.from_string("\n".join(block)))
+
+    # parameters block
+    in_params = False
+    for j in range(i, len(lines)):
+        s = lines[j].strip()
+        if s == "parameters:":
+            in_params = True
+        elif s == "end of parameters":
+            in_params = False
+        elif in_params and s.startswith("[") and s.endswith("]"):
+            inner = s[1:-1]
+            if ": " in inner:
+                k, v = inner.split(": ", 1)
+                model.params[k] = v
+    return model
+
+
+def dump_model_to_json(booster, num_iteration: int = -1,
+                       start_iteration: int = 0) -> dict:
+    """(ref: GBDT::DumpModel)"""
+    cfg = booster.config
+    ds = booster.train_set
+    end = len(booster.models) if num_iteration < 0 else min(
+        len(booster.models), start_iteration + num_iteration)
+    trees = []
+    idx = 0
+    for it in range(start_iteration, end):
+        for tree in booster.models[it]:
+            trees.append(tree.to_json(idx))
+            idx += 1
+    return {
+        "name": "tree",
+        "version": "v4",
+        "num_class": max(cfg.num_class, 1),
+        "num_tree_per_iteration": booster.num_tree_per_iteration,
+        "label_index": ds.label_idx,
+        "max_feature_idx": ds.num_total_features - 1,
+        "objective": _objective_string(cfg),
+        "average_output": booster.boosting_type == "rf",
+        "feature_names": ds.feature_names,
+        "feature_infos": {n: i for n, i in zip(ds.feature_names,
+                                               ds.feature_infos())},
+        "tree_info": trees,
+        "feature_importances": {
+            ds.feature_names[i]: float(v)
+            for i, v in enumerate(booster.feature_importance("split"))
+            if v > 0},
+    }

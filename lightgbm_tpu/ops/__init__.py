@@ -1,0 +1,1 @@
+from . import histogram, partition, split  # noqa: F401

@@ -1,0 +1,33 @@
+"""Row partition op (device).
+
+TPU-native replacement for the reference DataPartition
+(ref: src/treelearner/data_partition.hpp:22, cuda_data_partition.cu:291).
+Rather than physically permuting row indices per leaf, we keep a full-length
+``row_leaf: [N] int32`` map (row -> leaf id) and update it with masked
+`where` — the mask-over-permutation idiom that XLA/TPU prefers.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .split import MISSING_NAN
+
+
+def apply_split(row_leaf: jax.Array, bins_fm: jax.Array,
+                leaf_id: jax.Array, new_leaf_id: jax.Array,
+                feature: jax.Array, threshold: jax.Array,
+                default_left: jax.Array, num_bins: jax.Array,
+                missing_type: jax.Array, valid: jax.Array) -> jax.Array:
+    """Send rows of `leaf_id` with bin > threshold to `new_leaf_id`.
+
+    The NaN bin (last bin when missing_type == NAN) follows `default_left`.
+    No-op when `valid` is False.
+    """
+    fbins = jnp.take(bins_fm, feature, axis=0).astype(jnp.int32)  # [N]
+    nan_bin = num_bins[feature] - 1
+    is_nan = (missing_type[feature] == MISSING_NAN) & (fbins == nan_bin)
+    go_left = jnp.where(is_nan, default_left, fbins <= threshold)
+    move = valid & (row_leaf == leaf_id) & ~go_left
+    return jnp.where(move, new_leaf_id, row_leaf)

@@ -1,0 +1,204 @@
+"""Best-split search over histograms (device).
+
+TPU-native replacement for the reference split kernels
+(ref: src/treelearner/feature_histogram.hpp:166 FindBestThreshold,
+src/treelearner/cuda/cuda_best_split_finder.cu:776). The per-feature
+sequential threshold scan becomes a fully vectorized prefix-sum + gain
+evaluation over ``[F, B]`` with a global argmax, evaluated for both
+missing-value directions (the reference's two-direction scan).
+
+Split semantics (numerical): rows with ``bin <= threshold`` go left; the
+NaN bin (when missing_type == NAN) is the feature's last bin and goes to
+the side indicated by ``default_left``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .histogram import GRAD, HESS, COUNT
+
+MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
+K_MIN_SCORE = -1e30
+K_EPSILON = 1e-15
+
+
+class SplitHyperParams(NamedTuple):
+    """Dynamic (traced) regularization scalars (ref: config.h)."""
+    lambda_l1: jax.Array
+    lambda_l2: jax.Array
+    min_data_in_leaf: jax.Array
+    min_sum_hessian_in_leaf: jax.Array
+    min_gain_to_split: jax.Array
+    max_delta_step: jax.Array
+
+    @classmethod
+    def from_config(cls, cfg) -> "SplitHyperParams":
+        f = jnp.float32
+        return cls(
+            lambda_l1=jnp.asarray(cfg.lambda_l1, f),
+            lambda_l2=jnp.asarray(cfg.lambda_l2, f),
+            min_data_in_leaf=jnp.asarray(cfg.min_data_in_leaf, f),
+            min_sum_hessian_in_leaf=jnp.asarray(
+                max(cfg.min_sum_hessian_in_leaf, K_EPSILON), f),
+            min_gain_to_split=jnp.asarray(cfg.min_gain_to_split, f),
+            max_delta_step=jnp.asarray(cfg.max_delta_step, f),
+        )
+
+
+class FeatureMeta(NamedTuple):
+    """Static per-feature binning metadata, as device arrays.
+
+    num_bins: [F] actual bin count per feature (<= B).
+    missing_type: [F] MISSING_* code.
+    default_bin: [F] bin that value 0.0 maps to.
+    is_categorical: [F] bool.
+    monotone: [F] int8 in {-1, 0, +1}.
+    penalty: [F] multiplicative gain penalty (feature_contri; 1.0 = none).
+    """
+    num_bins: jax.Array
+    missing_type: jax.Array
+    default_bin: jax.Array
+    is_categorical: jax.Array
+    monotone: jax.Array
+    penalty: jax.Array
+
+
+class SplitInfo(NamedTuple):
+    """Best split for one leaf — scalar fields (ref: split_info.hpp:22)."""
+    gain: jax.Array          # gain above (parent_gain + min_gain_to_split); <=0 => no split
+    feature: jax.Array       # int32 feature index
+    threshold: jax.Array     # int32 bin threshold (bin <= threshold -> left)
+    default_left: jax.Array  # bool
+    left_sum_grad: jax.Array
+    left_sum_hess: jax.Array
+    left_count: jax.Array
+    right_sum_grad: jax.Array
+    right_sum_hess: jax.Array
+    right_count: jax.Array
+    left_output: jax.Array
+    right_output: jax.Array
+
+
+def threshold_l1(s: jax.Array, l1: jax.Array) -> jax.Array:
+    """Soft-threshold by lambda_l1 (ref: feature_histogram.hpp ThresholdL1)."""
+    return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)
+
+
+def leaf_output(sum_grad, sum_hess, hp: SplitHyperParams):
+    """Optimal leaf value -TL1(G)/(H+l2), clipped by max_delta_step
+    (ref: feature_histogram.hpp CalculateSplittedLeafOutput)."""
+    raw = -threshold_l1(sum_grad, hp.lambda_l1) / (sum_hess + hp.lambda_l2)
+    return jnp.where(hp.max_delta_step > 0,
+                     jnp.clip(raw, -hp.max_delta_step, hp.max_delta_step), raw)
+
+
+def leaf_gain_given_output(sum_grad, sum_hess, output, hp: SplitHyperParams):
+    """-(2*TL1(G)*w + (H+l2)*w^2) — equals TL1(G)^2/(H+l2) at the optimum
+    (ref: feature_histogram.hpp GetLeafGainGivenOutput)."""
+    g = threshold_l1(sum_grad, hp.lambda_l1)
+    return -(2.0 * g * output + (sum_hess + hp.lambda_l2) * output * output)
+
+
+def leaf_gain(sum_grad, sum_hess, hp: SplitHyperParams):
+    return leaf_gain_given_output(sum_grad, sum_hess,
+                                  leaf_output(sum_grad, sum_hess, hp), hp)
+
+
+def find_best_split(hist: jax.Array,
+                    parent_sum_grad: jax.Array,
+                    parent_sum_hess: jax.Array,
+                    parent_count: jax.Array,
+                    meta: FeatureMeta,
+                    hp: SplitHyperParams,
+                    feature_mask: jax.Array) -> SplitInfo:
+    """Find the best numerical split across all features for one leaf.
+
+    hist: [F, B, 3]; parent_*: scalars; feature_mask: [F] bool (feature
+    fraction / interaction constraints). Returns scalar SplitInfo.
+    """
+    num_features, num_bin_slots, _ = hist.shape
+    prefix = jnp.cumsum(hist, axis=1)  # [F, B, 3]
+    t_idx = jnp.arange(num_bin_slots, dtype=jnp.int32)[None, :]  # [1, B]
+    nb = meta.num_bins[:, None]  # [F, 1]
+
+    # --- variant A: missing (NaN bin = last) goes RIGHT; left = prefix[t]
+    left_a = prefix  # [F, B, 3]
+    # --- variant B: missing goes LEFT. right = (non-NaN rows above t)
+    #     = prefix[nb-2] - prefix[t]; left = parent - right.
+    last_non_nan = jnp.take_along_axis(
+        prefix, jnp.maximum(meta.num_bins - 2, 0)[:, None, None], axis=1)  # [F,1,3]
+    right_b = jnp.maximum(last_non_nan - prefix, 0.0)
+
+    parent = jnp.stack([parent_sum_grad, parent_sum_hess, parent_count])
+
+    def eval_variant(left, right, valid_extra):
+        gl, hl, cl = left[..., GRAD], left[..., HESS], left[..., COUNT]
+        gr, hr, cr = right[..., GRAD], right[..., HESS], right[..., COUNT]
+        out_l = leaf_output(gl, hl, hp)
+        out_r = leaf_output(gr, hr, hp)
+        gain = (leaf_gain_given_output(gl, hl, out_l, hp)
+                + leaf_gain_given_output(gr, hr, out_r, hp))
+        # monotone constraints, basic method (ref: monotone_constraints.hpp:466):
+        # increasing (+1) requires left_output <= right_output.
+        mono = meta.monotone[:, None]
+        mono_ok = jnp.where(
+            mono == 0, True,
+            jnp.where(mono > 0, out_l <= out_r, out_l >= out_r))
+        valid = (
+            valid_extra
+            & mono_ok
+            & (cl >= jnp.maximum(hp.min_data_in_leaf, 1.0))
+            & (cr >= jnp.maximum(hp.min_data_in_leaf, 1.0))
+            & (hl >= hp.min_sum_hessian_in_leaf)
+            & (hr >= hp.min_sum_hessian_in_leaf)
+            & feature_mask[:, None]
+            & ~meta.is_categorical[:, None]
+        )
+        gain = gain * meta.penalty[:, None]
+        return jnp.where(valid, gain, K_MIN_SCORE)
+
+    base_valid_a = t_idx < nb - 1
+    gains_a = eval_variant(left_a, parent[None, None, :] - left_a, base_valid_a)
+
+    has_nan = meta.missing_type[:, None] == MISSING_NAN
+    base_valid_b = has_nan & (t_idx < nb - 2)
+    gains_b = eval_variant(parent[None, None, :] - right_b, right_b, base_valid_b)
+
+    gains = jnp.stack([gains_a, gains_b], axis=-1)  # [F, B, 2]
+    flat = gains.reshape(-1)
+    best = jnp.argmax(flat)
+    best_gain_raw = flat[best]
+
+    feature = (best // (num_bin_slots * 2)).astype(jnp.int32)
+    threshold = ((best // 2) % num_bin_slots).astype(jnp.int32)
+    variant_b = (best % 2).astype(jnp.bool_)
+
+    la = left_a[feature, threshold]
+    rb = right_b[feature, threshold]
+    left = jnp.where(variant_b, parent - rb, la)
+    right = jnp.where(variant_b, rb, parent - la)
+
+    parent_gain = leaf_gain(parent_sum_grad, parent_sum_hess, hp)
+    gain = best_gain_raw - parent_gain - hp.min_gain_to_split
+    gain = jnp.where(best_gain_raw <= K_MIN_SCORE * 0.5, K_MIN_SCORE, gain)
+
+    mt = meta.missing_type[feature]
+    default_left = jnp.where(
+        mt == MISSING_NAN, variant_b,
+        jnp.where(mt == MISSING_ZERO,
+                  meta.default_bin[feature] <= threshold, False))
+
+    return SplitInfo(
+        gain=gain,
+        feature=feature,
+        threshold=threshold,
+        default_left=default_left,
+        left_sum_grad=left[GRAD], left_sum_hess=left[HESS], left_count=left[COUNT],
+        right_sum_grad=right[GRAD], right_sum_hess=right[HESS], right_count=right[COUNT],
+        left_output=leaf_output(left[GRAD], left[HESS], hp),
+        right_output=leaf_output(right[GRAD], right[HESS], hp),
+    )

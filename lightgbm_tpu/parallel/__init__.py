@@ -1,0 +1,1 @@
+from .mesh import get_mesh, shard_data, replicate  # noqa: F401

@@ -1,0 +1,60 @@
+"""Device mesh utilities.
+
+TPU-native replacement for the reference Network layer
+(ref: src/network/network.cpp, include/LightGBM/network.h:90). Machine
+lists, sockets and Bruck/recursive-halving collectives are replaced by a
+`jax.sharding.Mesh` over ICI/DCN: arrays carry shardings and XLA's SPMD
+partitioner inserts the all-reduce / reduce-scatter / all-gather
+collectives that the reference implements by hand.
+
+Axis names:
+  "data" — row (data-parallel) axis: the analog of
+           DataParallelTreeLearner's machine axis (parallel_tree_learner.h:54).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+
+_active_mesh: Optional[Mesh] = None
+
+
+def get_mesh(num_shards: int = 0, devices=None) -> Mesh:
+    """Build (or fetch) a 1-D data-parallel mesh.
+
+    num_shards=0 -> all local devices. A mesh with one device degrades to
+    the serial learner (XLA elides the collectives).
+    """
+    global _active_mesh
+    if devices is None:
+        devices = jax.devices()
+    if num_shards and num_shards > 0:
+        devices = devices[:num_shards]
+    if (_active_mesh is not None
+            and list(_active_mesh.devices.flat) == list(devices)):
+        return _active_mesh
+    _active_mesh = Mesh(np.asarray(devices), (DATA_AXIS,))
+    return _active_mesh
+
+
+def shard_data(mesh: Mesh, array, row_axis: int):
+    """Place `array` sharded along its row dimension (rows over "data")."""
+    spec = [None] * array.ndim
+    spec[row_axis] = DATA_AXIS
+    sharding = NamedSharding(mesh, P(*spec))
+    return jax.device_put(array, sharding)
+
+
+def replicate(mesh: Mesh, array):
+    return jax.device_put(array, NamedSharding(mesh, P()))
+
+
+def num_machines() -> int:
+    """Reference Network::num_machines analog."""
+    return _active_mesh.size if _active_mesh is not None else 1

@@ -1,0 +1,64 @@
+"""Model refit: keep tree structures, refresh leaf values on new data.
+
+(ref: GBDT::RefitTree gbdt.cpp:267, Booster.refit basic.py,
+refit_decay_rate in config.h.)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def refit_booster(booster, data, label, decay_rate: float = 0.9):
+    """Returns a new Booster whose leaf values are
+    decay * old + (1 - decay) * new_leaf_optimum on `data`."""
+    from .basic import Booster, Dataset
+
+    data = np.asarray(data, np.float64)
+    label = np.asarray(label, np.float32)
+
+    new_booster = Booster(model_str=booster.model_to_string())
+    gbdt = booster._gbdt
+    cfg = gbdt.config
+
+    # leaf assignments of new data under existing structures
+    leaf_preds = booster.predict(data, pred_leaf=True)  # [N, T]
+    if leaf_preds.ndim == 1:
+        leaf_preds = leaf_preds[:, None]
+
+    # fresh objective on the new labels
+    from .dataset import Metadata
+    from .objectives import create_objective
+    meta = Metadata(len(label))
+    meta.set_label(label)
+    obj = create_objective(cfg)
+    obj.init(meta, len(label))
+
+    import jax.numpy as jnp
+    k = gbdt.num_tree_per_iteration
+    scores = np.zeros((k, len(label)), np.float32)
+    t = 0
+    loaded = new_booster._loaded
+    for it in range(loaded.num_iterations):
+        for ki in range(k):
+            tree = loaded.trees[it * k + ki]
+            if hasattr(obj, "get_gradients_multi"):
+                g_all, h_all = obj.get_gradients_multi(jnp.asarray(scores))
+                grad = np.asarray(g_all[ki], np.float64)
+                hess = np.asarray(h_all[ki], np.float64)
+            else:
+                g, h = obj.get_gradients(jnp.asarray(scores[ki]))
+                grad, hess = np.asarray(g, np.float64), np.asarray(h, np.float64)
+            leaves = leaf_preds[:, t]
+            lam = cfg.lambda_l2
+            for leaf in range(tree.num_leaves):
+                m = leaves == leaf
+                if not m.any():
+                    continue
+                gsum, hsum = grad[m].sum(), hess[m].sum()
+                new_out = -gsum / (hsum + lam) * tree.shrinkage
+                tree.leaf_value[leaf] = (decay_rate * tree.leaf_value[leaf]
+                                         + (1.0 - decay_rate) * new_out)
+            scores[ki] += tree.leaf_value[leaves]
+            t += 1
+    return new_booster

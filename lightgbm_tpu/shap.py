@@ -1,0 +1,168 @@
+"""SHAP feature contributions (TreeSHAP).
+
+(ref: include/LightGBM/tree.h PredictContrib + the treeshap recursion in
+src/io/tree.cpp; algorithm from Lundberg et al. "Consistent
+Individualized Feature Attribution for Tree Ensembles".)
+
+Exact path-dependent TreeSHAP over the host tree arrays. Output layout
+matches the reference: [N, (F+1) * K] with the last slot per class being
+the expected value (bias).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _PathElement:
+    __slots__ = ("feature_index", "zero_fraction", "one_fraction",
+                 "pweight")
+
+    def __init__(self, feature_index=-1, zero_fraction=0.0, one_fraction=0.0,
+                 pweight=0.0):
+        self.feature_index = feature_index
+        self.zero_fraction = zero_fraction
+        self.one_fraction = one_fraction
+        self.pweight = pweight
+
+
+def _extend_path(path, unique_depth, zero_fraction, one_fraction,
+                 feature_index):
+    path[unique_depth].feature_index = feature_index
+    path[unique_depth].zero_fraction = zero_fraction
+    path[unique_depth].one_fraction = one_fraction
+    path[unique_depth].pweight = 1.0 if unique_depth == 0 else 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        path[i + 1].pweight += (one_fraction * path[i].pweight * (i + 1)
+                                / (unique_depth + 1))
+        path[i].pweight = (zero_fraction * path[i].pweight
+                           * (unique_depth - i) / (unique_depth + 1))
+
+
+def _unwind_path(path, unique_depth, path_index):
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = path[i].pweight
+            path[i].pweight = (next_one_portion * (unique_depth + 1)
+                               / ((i + 1) * one_fraction))
+            next_one_portion = tmp - path[i].pweight * zero_fraction * \
+                (unique_depth - i) / (unique_depth + 1)
+        else:
+            path[i].pweight = (path[i].pweight * (unique_depth + 1)
+                               / (zero_fraction * (unique_depth - i)))
+    for i in range(path_index, unique_depth):
+        path[i].feature_index = path[i + 1].feature_index
+        path[i].zero_fraction = path[i + 1].zero_fraction
+        path[i].one_fraction = path[i + 1].one_fraction
+
+
+def _unwound_path_sum(path, unique_depth, path_index):
+    one_fraction = path[path_index].one_fraction
+    zero_fraction = path[path_index].zero_fraction
+    next_one_portion = path[unique_depth].pweight
+    total = 0.0
+    for i in range(unique_depth - 1, -1, -1):
+        if one_fraction != 0:
+            tmp = (next_one_portion * (unique_depth + 1)
+                   / ((i + 1) * one_fraction))
+            total += tmp
+            next_one_portion = (path[i].pweight - tmp * zero_fraction *
+                                ((unique_depth - i) / (unique_depth + 1)))
+        else:
+            total += (path[i].pweight / zero_fraction
+                      / ((unique_depth - i) / (unique_depth + 1)))
+    return total
+
+
+def _tree_shap(tree, row, phi, node, unique_depth, parent_path,
+               parent_zero_fraction, parent_one_fraction,
+               parent_feature_index):
+    path = [_PathElement(p.feature_index, p.zero_fraction, p.one_fraction,
+                         p.pweight) for p in parent_path[:unique_depth]] + \
+        [_PathElement() for _ in range(2)]
+    _extend_path(path, unique_depth, parent_zero_fraction,
+                 parent_one_fraction, parent_feature_index)
+
+    if node < 0:  # leaf
+        leaf = ~node
+        for i in range(1, unique_depth + 1):
+            w = _unwound_path_sum(path, unique_depth, i)
+            el = path[i]
+            phi[el.feature_index] += (w * (el.one_fraction - el.zero_fraction)
+                                      * tree.leaf_value[leaf])
+        return
+
+    hot, cold = _decide_children(tree, node, row)
+    node_count = tree.internal_count[node]
+    hot_count = _child_count(tree, hot)
+    cold_count = _child_count(tree, cold)
+    incoming_zero_fraction = 1.0
+    incoming_one_fraction = 1.0
+    feature = tree.split_feature[node]
+
+    # dedup: if we've seen this feature before on the path, unwind it
+    path_index = 0
+    while path_index <= unique_depth:
+        if path[path_index].feature_index == feature:
+            break
+        path_index += 1
+    if path_index != unique_depth + 1:
+        incoming_zero_fraction = path[path_index].zero_fraction
+        incoming_one_fraction = path[path_index].one_fraction
+        _unwind_path(path, unique_depth, path_index)
+        unique_depth -= 1
+
+    denom = node_count if node_count > 0 else 1
+    _tree_shap(tree, row, phi, hot, unique_depth + 1, path,
+               hot_count / denom * incoming_zero_fraction,
+               incoming_one_fraction, feature)
+    _tree_shap(tree, row, phi, cold, unique_depth + 1, path,
+               cold_count / denom * incoming_zero_fraction, 0.0, feature)
+
+
+def _child_count(tree, child):
+    if child < 0:
+        return float(tree.leaf_count[~child])
+    return float(tree.internal_count[child])
+
+
+def _decide_children(tree, node, row):
+    go_left = tree._decide(node, row[tree.split_feature[node]])
+    if go_left:
+        return tree.left_child[node], tree.right_child[node]
+    return tree.right_child[node], tree.left_child[node]
+
+
+def _expected_value(tree) -> float:
+    if tree.num_internal == 0:
+        return float(tree.leaf_value[0])
+    total = tree.leaf_count.sum()
+    if total <= 0:
+        return float(np.mean(tree.leaf_value))
+    return float(np.sum(tree.leaf_value * tree.leaf_count) / total)
+
+
+def predict_contrib(booster, data: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+    data = np.asarray(data, np.float64)
+    n, f_raw = data.shape
+    k = booster.num_tree_per_iteration
+    num_feat = booster.train_set.num_total_features
+    out = np.zeros((n, k, num_feat + 1))
+    end = len(booster.models) if num_iteration < 0 else min(
+        len(booster.models), start_iteration + num_iteration)
+    for it in range(start_iteration, end):
+        for ki, tree in enumerate(booster.models[it]):
+            base = _expected_value(tree)
+            out[:, ki, -1] += base
+            if tree.num_internal == 0:
+                continue
+            for r in range(n):
+                phi = np.zeros(num_feat + 1)
+                _tree_shap(tree, data[r], phi, 0, 0, [], 1.0, 1.0, -1)
+                out[r, ki, :-1] += phi[:-1]
+    return out.reshape(n, k * (num_feat + 1)) if k > 1 else \
+        out.reshape(n, num_feat + 1)

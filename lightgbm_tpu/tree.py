@@ -1,0 +1,351 @@
+"""Tree model: flat-array binary tree, prediction, text serialization.
+
+Host-side mirror of the reference Tree (ref: include/LightGBM/tree.h:27,
+src/io/tree.cpp). Trees are built from the learner's TreeArrays record by
+replaying splits (the same numbering as Tree::Split: internal node s is
+created by split s; the left child keeps the parent's leaf id, the right
+child becomes leaf id s+1). Serialization follows the reference text model
+format (ref: src/boosting/gbdt_model_text.cpp:315) so models interoperate.
+
+Child index convention (same as reference): >= 0 -> internal node id,
+< 0 -> ~leaf_id.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
+
+_CATEGORICAL_MASK = 1
+_DEFAULT_LEFT_MASK = 2
+
+
+class Tree:
+    """One decision tree with LightGBM-compatible arrays."""
+
+    def __init__(self, num_leaves: int):
+        n = max(num_leaves, 1)
+        self.num_leaves = n
+        self.num_internal = max(n - 1, 0)
+        i = self.num_internal
+        self.split_feature = np.zeros(i, np.int32)       # raw feature index
+        self.split_feature_inner = np.zeros(i, np.int32)  # used-feature index
+        self.threshold = np.zeros(i, np.float64)          # real-valued
+        self.threshold_bin = np.zeros(i, np.int32)
+        self.decision_type = np.zeros(i, np.int32)
+        self.left_child = np.full(i, -1, np.int32)
+        self.right_child = np.full(i, -1, np.int32)
+        self.split_gain = np.zeros(i, np.float64)
+        self.internal_value = np.zeros(i, np.float64)
+        self.internal_weight = np.zeros(i, np.float64)
+        self.internal_count = np.zeros(i, np.int64)
+        self.leaf_value = np.zeros(n, np.float64)
+        self.leaf_weight = np.zeros(n, np.float64)
+        self.leaf_count = np.zeros(n, np.int64)
+        self.leaf_parent = np.full(n, -1, np.int32)
+        self.shrinkage = 1.0
+        # categorical support: threshold_bin indexes cat_boundaries segments
+        self.cat_boundaries: List[int] = [0]
+        self.cat_threshold: List[int] = []  # packed uint32 bitsets
+        self.num_cat = 0
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_arrays(cls, rec, mappers, used_features) -> "Tree":
+        """Build from learner TreeArrays (numpy-converted)."""
+        num_leaves = int(rec["num_leaves"])
+        tree = cls(num_leaves)
+        split_leaf = rec["split_leaf"]
+
+        # leaf id -> (node, side) reference for replay
+        leaf_ref = {}
+        for s in range(tree.num_internal):
+            if split_leaf[s] < 0:
+                break
+            leaf = int(split_leaf[s])
+            node = s
+            if leaf in leaf_ref:
+                pnode, side = leaf_ref[leaf]
+                if side == 0:
+                    tree.left_child[pnode] = node
+                else:
+                    tree.right_child[pnode] = node
+            inner = int(rec["split_feature"][s])
+            mapper = mappers[inner]
+            tbin = int(rec["split_bin_threshold"][s])
+            tree.split_feature_inner[node] = inner
+            tree.split_feature[node] = used_features[inner]
+            tree.threshold_bin[node] = tbin
+            dt = 0
+            if mapper.is_categorical:
+                dt |= _CATEGORICAL_MASK
+                tree._add_categorical(node, mapper, tbin, rec, s)
+            else:
+                tree.threshold[node] = mapper.bin_to_value(tbin)
+            if bool(rec["split_default_left"][s]):
+                dt |= _DEFAULT_LEFT_MASK
+            dt |= int(mapper.missing_type) << 2
+            tree.decision_type[node] = dt
+            tree.split_gain[node] = float(rec["split_gain"][s])
+            tree.internal_value[node] = float(rec["internal_value"][s])
+            tree.internal_weight[node] = float(rec["internal_weight"][s])
+            tree.internal_count[node] = int(rec["internal_count"][s])
+            tree.left_child[node] = ~leaf
+            tree.right_child[node] = ~(s + 1)
+            leaf_ref[leaf] = (node, 0)
+            leaf_ref[s + 1] = (node, 1)
+
+        for leaf, (pnode, _) in leaf_ref.items():
+            if leaf < num_leaves:
+                tree.leaf_parent[leaf] = pnode
+        tree.leaf_value = np.asarray(rec["leaf_value"][:num_leaves], np.float64)
+        tree.leaf_weight = np.asarray(rec["leaf_weight"][:num_leaves], np.float64)
+        tree.leaf_count = np.asarray(rec["leaf_count"][:num_leaves], np.int64)
+        return tree
+
+    def _add_categorical(self, node, mapper, tbin, rec, s):
+        """Categorical split: bins in the recorded set go left. The learner
+        encodes one-hot categorical splits as bin == threshold -> left
+        (ref: tree.h:375 CategoricalDecision bitset)."""
+        cat_value = mapper.bin_to_value(tbin)
+        # bitset over category values (ref: Common::ConstructBitset)
+        max_val = int(max(cat_value, 0))
+        nwords = max_val // 32 + 1
+        bits = [0] * nwords
+        bits[max_val // 32] |= 1 << (max_val % 32)
+        self.threshold_bin[node] = self.num_cat
+        self.threshold[node] = self.num_cat
+        self.cat_boundaries.append(self.cat_boundaries[-1] + nwords)
+        self.cat_threshold.extend(bits)
+        self.num_cat += 1
+
+    # ------------------------------------------------------------------
+    def apply_shrinkage(self, rate: float) -> None:
+        """(ref: tree.h:189 Tree::Shrinkage)"""
+        self.leaf_value *= rate
+        self.internal_value *= rate
+        self.shrinkage *= rate
+
+    def add_bias(self, value: float) -> None:
+        self.leaf_value += value
+        self.internal_value += value
+
+    # ------------------------------------------------------------------
+    def _decide(self, node: int, value: float) -> bool:
+        """True -> go left (ref: tree.h:338 NumericalDecision)."""
+        dt = self.decision_type[node]
+        if dt & _CATEGORICAL_MASK:
+            if np.isnan(value):
+                return False
+            iv = int(value)
+            if iv < 0:
+                return False
+            cat_idx = int(self.threshold[node])
+            lo = self.cat_boundaries[cat_idx]
+            hi = self.cat_boundaries[cat_idx + 1]
+            word = iv // 32
+            if word >= hi - lo:
+                return False
+            return bool((self.cat_threshold[lo + word] >> (iv % 32)) & 1)
+        missing_type = (dt >> 2) & 3
+        default_left = bool(dt & _DEFAULT_LEFT_MASK)
+        if np.isnan(value) and missing_type != MISSING_ZERO:
+            if missing_type == MISSING_NAN:
+                return default_left
+            value = 0.0
+        if missing_type == MISSING_ZERO and (np.isnan(value) or
+                                             abs(value) <= 1e-35):
+            return default_left
+        return value <= self.threshold[node]
+
+    def predict_row(self, row: np.ndarray) -> float:
+        return self.leaf_value[self.predict_leaf_row(row)]
+
+    def predict_leaf_row(self, row: np.ndarray) -> int:
+        if self.num_internal == 0:
+            return 0
+        node = 0
+        while True:
+            child = (self.left_child[node]
+                     if self._decide(node, row[self.split_feature[node]])
+                     else self.right_child[node])
+            if child < 0:
+                return ~child
+            node = child
+
+    def predict(self, data: np.ndarray) -> np.ndarray:
+        """Vectorized batch prediction over raw feature values."""
+        return self.leaf_value[self.predict_leaf(data)]
+
+    def predict_leaf(self, data: np.ndarray) -> np.ndarray:
+        n = data.shape[0]
+        if self.num_internal == 0:
+            return np.zeros(n, np.int32)
+        # iterative vectorized traversal: node id per row; leaves = ~id
+        node = np.zeros(n, np.int32)
+        done = np.zeros(n, bool)
+        out = np.zeros(n, np.int32)
+        for _ in range(self.num_internal + 1):
+            if done.all():
+                break
+            active = ~done
+            nd = node[active]
+            feat = self.split_feature[nd]
+            vals = data[active, feat]
+            go_left = self._decide_vec(nd, vals)
+            child = np.where(go_left, self.left_child[nd],
+                             self.right_child[nd])
+            is_leaf = child < 0
+            idx = np.flatnonzero(active)
+            out[idx[is_leaf]] = ~child[is_leaf]
+            done[idx[is_leaf]] = True
+            node[idx[~is_leaf]] = child[~is_leaf]
+        return out
+
+    def _decide_vec(self, nodes: np.ndarray, values: np.ndarray) -> np.ndarray:
+        dt = self.decision_type[nodes]
+        thr = self.threshold[nodes]
+        missing_type = (dt >> 2) & 3
+        default_left = (dt & _DEFAULT_LEFT_MASK) > 0
+        is_cat = (dt & _CATEGORICAL_MASK) > 0
+        isnan = np.isnan(values)
+        vals = np.where(isnan, 0.0, values)
+
+        res = vals <= thr
+        # missing routing
+        use_default = (isnan & (missing_type == MISSING_NAN)) | \
+            ((missing_type == MISSING_ZERO) & (isnan | (np.abs(vals) <= 1e-35)))
+        res = np.where(use_default, default_left, res)
+        # NaN with non-nan missing type: treated as 0.0 (already via vals)
+        if is_cat.any():
+            cat_rows = np.flatnonzero(is_cat)
+            for r in cat_rows:
+                res[r] = self._decide(nodes[r], values[r])
+        return res
+
+    # ------------------------------------------------------------------
+    def to_string(self, tree_idx: int) -> str:
+        """Serialize (ref: gbdt_model_text.cpp per-tree block)."""
+        lines = [f"Tree={tree_idx}"]
+        lines.append(f"num_leaves={self.num_leaves}")
+        lines.append(f"num_cat={self.num_cat}")
+        if self.num_internal:
+            lines.append("split_feature=" +
+                         " ".join(map(str, self.split_feature)))
+            lines.append("split_gain=" +
+                         " ".join(_fmt(v) for v in self.split_gain))
+            lines.append("threshold=" +
+                         " ".join(_fmt(v) for v in self.threshold))
+            lines.append("decision_type=" +
+                         " ".join(map(str, self.decision_type)))
+            lines.append("left_child=" + " ".join(map(str, self.left_child)))
+            lines.append("right_child=" + " ".join(map(str, self.right_child)))
+            lines.append("internal_value=" +
+                         " ".join(_fmt(v) for v in self.internal_value))
+            lines.append("internal_weight=" +
+                         " ".join(_fmt(v) for v in self.internal_weight))
+            lines.append("internal_count=" +
+                         " ".join(map(str, self.internal_count)))
+        lines.append("leaf_value=" + " ".join(_fmt(v) for v in self.leaf_value))
+        lines.append("leaf_weight=" + " ".join(_fmt(v) for v in self.leaf_weight))
+        lines.append("leaf_count=" + " ".join(map(str, self.leaf_count)))
+        if self.num_cat > 0:
+            lines.append("cat_boundaries=" +
+                         " ".join(map(str, self.cat_boundaries)))
+            lines.append("cat_threshold=" +
+                         " ".join(map(str, self.cat_threshold)))
+        lines.append(f"shrinkage={_fmt(self.shrinkage)}")
+        lines.append("")
+        return "\n".join(lines)
+
+    @classmethod
+    def from_string(cls, text: str) -> "Tree":
+        """Parse one Tree= block (ref: tree.cpp Tree(const char*))."""
+        kv = {}
+        for line in text.strip().splitlines():
+            if "=" in line:
+                k, v = line.split("=", 1)
+                kv[k.strip()] = v.strip()
+        num_leaves = int(kv["num_leaves"])
+        tree = cls(num_leaves)
+        tree.num_cat = int(kv.get("num_cat", 0))
+
+        def parse(key, dtype, default=None):
+            if key not in kv or not kv[key]:
+                return default
+            return np.array([float(x) for x in kv[key].split()]).astype(dtype)
+
+        i = tree.num_internal
+        if i > 0:
+            tree.split_feature = parse("split_feature", np.int32)
+            tree.split_feature_inner = tree.split_feature.copy()
+            tree.split_gain = parse("split_gain", np.float64,
+                                    np.zeros(i)) if "split_gain" in kv else np.zeros(i)
+            tree.threshold = parse("threshold", np.float64)
+            tree.decision_type = parse("decision_type", np.int32, np.zeros(i, np.int32))
+            if tree.decision_type is None:
+                tree.decision_type = np.zeros(i, np.int32)
+            tree.left_child = parse("left_child", np.int32)
+            tree.right_child = parse("right_child", np.int32)
+            iv = parse("internal_value", np.float64)
+            tree.internal_value = iv if iv is not None else np.zeros(i)
+            iw = parse("internal_weight", np.float64)
+            tree.internal_weight = iw if iw is not None else np.zeros(i)
+            ic = parse("internal_count", np.int64)
+            tree.internal_count = ic if ic is not None else np.zeros(i, np.int64)
+        tree.leaf_value = parse("leaf_value", np.float64)
+        lw = parse("leaf_weight", np.float64)
+        tree.leaf_weight = lw if lw is not None else np.zeros(num_leaves)
+        lc = parse("leaf_count", np.int64)
+        tree.leaf_count = lc if lc is not None else np.zeros(num_leaves, np.int64)
+        if tree.num_cat > 0:
+            tree.cat_boundaries = [int(x) for x in kv["cat_boundaries"].split()]
+            tree.cat_threshold = [int(x) for x in kv["cat_threshold"].split()]
+        tree.shrinkage = float(kv.get("shrinkage", 1.0))
+        return tree
+
+    # ------------------------------------------------------------------
+    def to_json(self, tree_idx: int) -> dict:
+        """(ref: tree.h ToJSON)"""
+        return {
+            "tree_index": tree_idx,
+            "num_leaves": int(self.num_leaves),
+            "num_cat": int(self.num_cat),
+            "shrinkage": float(self.shrinkage),
+            "tree_structure": self._node_json(0 if self.num_internal else ~0),
+        }
+
+    def _node_json(self, node: int) -> dict:
+        if node < 0:
+            leaf = ~node
+            return {
+                "leaf_index": int(leaf),
+                "leaf_value": float(self.leaf_value[leaf]),
+                "leaf_weight": float(self.leaf_weight[leaf]),
+                "leaf_count": int(self.leaf_count[leaf]),
+            }
+        dt = int(self.decision_type[node])
+        out = {
+            "split_index": int(node),
+            "split_feature": int(self.split_feature[node]),
+            "split_gain": float(self.split_gain[node]),
+            "threshold": float(self.threshold[node]),
+            "decision_type": "==" if dt & _CATEGORICAL_MASK else "<=",
+            "default_left": bool(dt & _DEFAULT_LEFT_MASK),
+            "missing_type": ["None", "Zero", "NaN"][(dt >> 2) & 3],
+            "internal_value": float(self.internal_value[node]),
+            "internal_weight": float(self.internal_weight[node]),
+            "internal_count": int(self.internal_count[node]),
+            "left_child": self._node_json(self.left_child[node]),
+            "right_child": self._node_json(self.right_child[node]),
+        }
+        return out
+
+
+def _fmt(v: float) -> str:
+    """Shortest round-trip float formatting (the reference uses
+    Common::DoubleToStr with %.17g)."""
+    return repr(float(v))
