@@ -116,10 +116,9 @@ class GBDT:
         self._sample_mask = jnp.ones(self.num_data, jnp.float32)
         self._grad_scale = None  # GOSS amplification, set per iter
 
-        # grown-tree jit (shared across iterations)
-        self._grow = functools.partial(
-            grow_tree, **self._static,
-            hist_dtype=jnp.float32)
+        # grown-tree jit (shared across iterations; one XLA program per tree)
+        self._grow = jax.jit(functools.partial(
+            grow_tree, **self._static, hist_dtype=jnp.float32))
         self._update_score = jax.jit(
             lambda score, leaf_vals, row_leaf: score + leaf_vals[row_leaf])
         self._valid_sets: List = []
@@ -344,7 +343,9 @@ class GBDT:
             nan_bin = num_bins[feat] - 1
             is_nan = (missing[feat] == 2) & (b == nan_bin)
             dleft = (tree.decision_type[nd] & 2) > 0
-            go_left = np.where(is_nan, dleft, b <= tbin)
+            cat = (tree.decision_type[nd] & 1) > 0
+            go_left = np.where(cat, b == tbin,
+                               np.where(is_nan, dleft, b <= tbin))
             child = np.where(go_left, tree.left_child[nd],
                              tree.right_child[nd])
             is_leaf = child < 0

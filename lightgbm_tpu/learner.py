@@ -167,7 +167,7 @@ def grow_tree(bins_fm: jax.Array,
         # --- partition rows (left keeps best_leaf id, right -> new_leaf)
         row_leaf = part_ops.apply_split(
             state.row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
-            meta.num_bins, meta.missing_type, valid)
+            meta.num_bins, meta.missing_type, meta.is_categorical, valid)
 
         # --- children stats from the stored candidate
         lg = leaves.left_sum_grad[best_leaf]

@@ -30,7 +30,10 @@ def _hist_all_features(bins_fm: jax.Array, gh: jax.Array, max_bins: int,
 
     def one_feature(carry, feat_bins):
         onehot = (feat_bins[:, None] == bidx[None, :]).astype(dtype)  # [N, B]
-        return carry, onehot.T @ gh  # [B, 3]
+        # HIGHEST precision: the TPU MXU would otherwise truncate the f32
+        # grad/hess operand to bf16 (the one-hot side is exact either way)
+        h = jax.lax.dot(onehot.T, gh, precision=jax.lax.Precision.HIGHEST)
+        return carry, h  # [B, 3]
 
     _, hist = lax.scan(one_feature, None, bins_fm)
     return hist

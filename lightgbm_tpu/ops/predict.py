@@ -1,0 +1,161 @@
+"""Device (XLA) batch prediction over packed tree ensembles.
+
+TPU-native analog of the reference prediction kernels
+(ref: src/boosting/gbdt_prediction.cpp:16, CUDATree prediction kernels in
+src/io/cuda/cuda_tree.cu). Trees are packed into dense [T, ...] tensors;
+traversal is a `fori_loop` over depth with per-row gathers — all rows
+advance one level per step (leaves self-loop), so the program has static
+shape and vectorizes over the batch.
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_DEFAULT_LEFT_MASK = 2
+
+
+class PackedEnsemble(NamedTuple):
+    """Dense ensemble tensors. T trees, I = max internal nodes, L = max
+    leaves, D = max depth. Child convention: >=0 internal, <0 = ~leaf."""
+    split_feature: jax.Array   # [T, I] int32
+    threshold: jax.Array       # [T, I] f32 (real-valued)
+    decision_type: jax.Array   # [T, I] int32
+    left_child: jax.Array      # [T, I] int32
+    right_child: jax.Array     # [T, I] int32
+    leaf_value: jax.Array      # [T, L] f32
+    num_internal: jax.Array    # [T] int32
+    max_depth: int             # static
+    num_trees_per_class: int   # static (for multiclass reshape)
+
+
+def pack_ensemble(trees: List, num_tree_per_iteration: int = 1
+                  ) -> PackedEnsemble:
+    """Pack host Tree objects (tree.py) into device tensors.
+
+    Categorical splits are packed as equality splits on the single category
+    value (the learner emits one-hot categorical splits)."""
+    t = len(trees)
+    max_i = max((tr.num_internal for tr in trees), default=0)
+    max_i = max(max_i, 1)
+    max_l = max((tr.num_leaves for tr in trees), default=1)
+    sf = np.zeros((t, max_i), np.int32)
+    th = np.zeros((t, max_i), np.float64)
+    dt = np.zeros((t, max_i), np.int32)
+    lc = np.full((t, max_i), -1, np.int32)
+    rc = np.full((t, max_i), -1, np.int32)
+    lv = np.zeros((t, max_l), np.float32)
+    ni = np.zeros(t, np.int32)
+    depth = 1
+    for i, tr in enumerate(trees):
+        n = tr.num_internal
+        ni[i] = n
+        if n:
+            sf[i, :n] = tr.split_feature
+            dt[i, :n] = tr.decision_type
+            lc[i, :n] = tr.left_child
+            rc[i, :n] = tr.right_child
+            # categorical one-hot: threshold holds the category value and a
+            # flag bit; decision becomes (value == threshold)
+            for nd in range(n):
+                if tr.decision_type[nd] & 1:
+                    cat_idx = int(tr.threshold[nd])
+                    lo = tr.cat_boundaries[cat_idx]
+                    hi = tr.cat_boundaries[cat_idx + 1]
+                    val = -1.0
+                    for w in range(lo, hi):
+                        bits = tr.cat_threshold[w]
+                        for b in range(32):
+                            if (bits >> b) & 1:
+                                val = (w - lo) * 32 + b
+                    th[i, nd] = val
+                else:
+                    th[i, nd] = tr.threshold[nd]
+        lv[i, :tr.num_leaves] = tr.leaf_value
+        depth = max(depth, _tree_depth(tr))
+    return PackedEnsemble(
+        split_feature=jnp.asarray(sf), threshold=jnp.asarray(th, jnp.float32),
+        decision_type=jnp.asarray(dt), left_child=jnp.asarray(lc),
+        right_child=jnp.asarray(rc), leaf_value=jnp.asarray(lv),
+        num_internal=jnp.asarray(ni), max_depth=int(depth),
+        num_trees_per_class=num_tree_per_iteration)
+
+
+def _tree_depth(tr) -> int:
+    if tr.num_internal == 0:
+        return 1
+    depth = np.zeros(tr.num_internal, np.int32)
+    out = 1
+    for nd in range(tr.num_internal):  # parents precede children
+        for child in (tr.left_child[nd], tr.right_child[nd]):
+            if child >= 0:
+                depth[child] = depth[nd] + 1
+                out = max(out, int(depth[child]) + 1)
+    return out + 1
+
+
+def predict_raw(ens: PackedEnsemble, x: jax.Array) -> jax.Array:
+    """x: [B, F] raw features (NaN = missing) -> raw scores [B, K]."""
+    num_rows = x.shape[0]
+
+    def one_tree(carry, tree):
+        sf, th, dt, lc, rc, lv, ni = tree
+
+        def body(_, node):
+            feat = sf[jnp.maximum(node, 0)]
+            val = jnp.take_along_axis(x, feat[:, None], axis=1)[:, 0]
+            thr = th[jnp.maximum(node, 0)]
+            d = dt[jnp.maximum(node, 0)]
+            default_left = (d & _DEFAULT_LEFT_MASK) > 0
+            missing_type = (d >> 2) & 3
+            is_cat = (d & 1) > 0
+            isnan = jnp.isnan(val)
+            v0 = jnp.where(isnan, 0.0, val)
+            go_left = jnp.where(is_cat, v0 == thr, v0 <= thr)
+            use_default = (isnan & (missing_type == 2)) | \
+                ((missing_type == 1) & (isnan | (jnp.abs(v0) <= 1e-35)))
+            go_left = jnp.where(use_default & ~is_cat, default_left, go_left)
+            nxt = jnp.where(go_left, lc[jnp.maximum(node, 0)],
+                            rc[jnp.maximum(node, 0)])
+            # leaves (node < 0) self-loop
+            return jnp.where(node < 0, node, nxt)
+
+        node0 = jnp.where(ni > 0, jnp.zeros(num_rows, jnp.int32),
+                          jnp.full(num_rows, -1, jnp.int32))
+        node = lax.fori_loop(0, ens.max_depth, body, node0)
+        leaf = jnp.where(node < 0, ~node, 0)
+        return carry + lv[leaf], None
+
+    total, _ = lax.scan(
+        one_tree, jnp.zeros(num_rows, jnp.float32),
+        (ens.split_feature, ens.threshold, ens.decision_type,
+         ens.left_child, ens.right_child, ens.leaf_value, ens.num_internal))
+    return total
+
+
+def predict_raw_multiclass(ens: PackedEnsemble, x: jax.Array) -> jax.Array:
+    """-> [B, K] for K = num_trees_per_class class streams."""
+    k = ens.num_trees_per_class
+    num_rows = x.shape[0]
+    if k == 1:
+        return predict_raw(ens, x)[:, None]
+    t = ens.split_feature.shape[0]
+    outs = []
+    for ki in range(k):
+        idx = jnp.arange(ki, t, k)
+        sub = PackedEnsemble(
+            split_feature=ens.split_feature[idx],
+            threshold=ens.threshold[idx],
+            decision_type=ens.decision_type[idx],
+            left_child=ens.left_child[idx],
+            right_child=ens.right_child[idx],
+            leaf_value=ens.leaf_value[idx],
+            num_internal=ens.num_internal[idx],
+            max_depth=ens.max_depth, num_trees_per_class=1)
+        outs.append(predict_raw(sub, x))
+    return jnp.stack(outs, axis=1)

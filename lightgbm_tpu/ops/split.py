@@ -156,31 +156,44 @@ def find_best_split(hist: jax.Array,
             & (hl >= hp.min_sum_hessian_in_leaf)
             & (hr >= hp.min_sum_hessian_in_leaf)
             & feature_mask[:, None]
-            & ~meta.is_categorical[:, None]
         )
         gain = gain * meta.penalty[:, None]
         return jnp.where(valid, gain, K_MIN_SCORE)
 
-    base_valid_a = t_idx < nb - 1
+    is_cat = meta.is_categorical[:, None]
+    base_valid_a = (t_idx < nb - 1) & ~is_cat
     gains_a = eval_variant(left_a, parent[None, None, :] - left_a, base_valid_a)
 
     has_nan = meta.missing_type[:, None] == MISSING_NAN
-    base_valid_b = has_nan & (t_idx < nb - 2)
+    base_valid_b = has_nan & (t_idx < nb - 2) & ~is_cat
     gains_b = eval_variant(parent[None, None, :] - right_b, right_b, base_valid_b)
 
-    gains = jnp.stack([gains_a, gains_b], axis=-1)  # [F, B, 2]
+    # --- variant C: categorical one-hot split, bin == t goes LEFT
+    # (ref: feature_histogram.hpp categorical one-hot branch when
+    # num_bins <= max_cat_to_onehot; bin 0 = "other/unseen" never splits
+    # left so binned and raw-value prediction stay consistent)
+    left_c = hist
+    base_valid_c = is_cat & (t_idx >= 1) & (t_idx < nb)
+    gains_c = eval_variant(left_c, parent[None, None, :] - left_c,
+                           base_valid_c)
+
+    gains = jnp.stack([gains_a, gains_b, gains_c], axis=-1)  # [F, B, 3]
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
     best_gain_raw = flat[best]
 
-    feature = (best // (num_bin_slots * 2)).astype(jnp.int32)
-    threshold = ((best // 2) % num_bin_slots).astype(jnp.int32)
-    variant_b = (best % 2).astype(jnp.bool_)
+    num_variants = 3
+    feature = (best // (num_bin_slots * num_variants)).astype(jnp.int32)
+    threshold = ((best // num_variants) % num_bin_slots).astype(jnp.int32)
+    variant = (best % num_variants).astype(jnp.int32)
+    variant_b = variant == 1
+    variant_c = variant == 2
 
     la = left_a[feature, threshold]
     rb = right_b[feature, threshold]
-    left = jnp.where(variant_b, parent - rb, la)
-    right = jnp.where(variant_b, rb, parent - la)
+    lc_ = left_c[feature, threshold]
+    left = jnp.where(variant_b, parent - rb, jnp.where(variant_c, lc_, la))
+    right = parent - left
 
     parent_gain = leaf_gain(parent_sum_grad, parent_sum_hess, hp)
     gain = best_gain_raw - parent_gain - hp.min_gain_to_split

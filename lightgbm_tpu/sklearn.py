@@ -1,0 +1,293 @@
+"""scikit-learn estimator API.
+
+(ref: python-package/lightgbm/sklearn.py:535 LGBMModel, :1409
+LGBMRegressor, :1524 LGBMClassifier, :1832 LGBMRanker.)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset, LightGBMError
+from .engine import train as train_fn
+
+
+class LGBMModel:
+    """Base estimator (ref: sklearn.py:535)."""
+
+    def __init__(self, boosting_type: str = "gbdt", num_leaves: int = 31,
+                 max_depth: int = -1, learning_rate: float = 0.1,
+                 n_estimators: int = 100, subsample_for_bin: int = 200000,
+                 objective: Optional[str] = None,
+                 class_weight=None, min_split_gain: float = 0.0,
+                 min_child_weight: float = 1e-3, min_child_samples: int = 20,
+                 subsample: float = 1.0, subsample_freq: int = 0,
+                 colsample_bytree: float = 1.0, reg_alpha: float = 0.0,
+                 reg_lambda: float = 0.0, random_state=None,
+                 n_jobs: Optional[int] = None, importance_type: str = "split",
+                 **kwargs):
+        self.boosting_type = boosting_type
+        self.num_leaves = num_leaves
+        self.max_depth = max_depth
+        self.learning_rate = learning_rate
+        self.n_estimators = n_estimators
+        self.subsample_for_bin = subsample_for_bin
+        self.objective = objective
+        self.class_weight = class_weight
+        self.min_split_gain = min_split_gain
+        self.min_child_weight = min_child_weight
+        self.min_child_samples = min_child_samples
+        self.subsample = subsample
+        self.subsample_freq = subsample_freq
+        self.colsample_bytree = colsample_bytree
+        self.reg_alpha = reg_alpha
+        self.reg_lambda = reg_lambda
+        self.random_state = random_state
+        self.n_jobs = n_jobs
+        self.importance_type = importance_type
+        self._other_params = dict(kwargs)
+        self._Booster: Optional[Booster] = None
+        self._n_features: Optional[int] = None
+        self._objective = objective
+        self.fitted_ = False
+
+    # -- sklearn plumbing ------------------------------------------------
+    def get_params(self, deep: bool = True) -> Dict[str, Any]:
+        params = {
+            "boosting_type": self.boosting_type, "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth, "learning_rate": self.learning_rate,
+            "n_estimators": self.n_estimators,
+            "subsample_for_bin": self.subsample_for_bin,
+            "objective": self.objective, "class_weight": self.class_weight,
+            "min_split_gain": self.min_split_gain,
+            "min_child_weight": self.min_child_weight,
+            "min_child_samples": self.min_child_samples,
+            "subsample": self.subsample, "subsample_freq": self.subsample_freq,
+            "colsample_bytree": self.colsample_bytree,
+            "reg_alpha": self.reg_alpha, "reg_lambda": self.reg_lambda,
+            "random_state": self.random_state, "n_jobs": self.n_jobs,
+            "importance_type": self.importance_type,
+        }
+        params.update(self._other_params)
+        return params
+
+    def set_params(self, **params) -> "LGBMModel":
+        for key, value in params.items():
+            if hasattr(self, key):
+                setattr(self, key, value)
+            else:
+                self._other_params[key] = value
+        return self
+
+    def _lgb_params(self) -> Dict[str, Any]:
+        p = {
+            "boosting": self.boosting_type,
+            "num_leaves": self.num_leaves,
+            "max_depth": self.max_depth,
+            "learning_rate": self.learning_rate,
+            "bin_construct_sample_cnt": self.subsample_for_bin,
+            "objective": self._objective,
+            "min_gain_to_split": self.min_split_gain,
+            "min_sum_hessian_in_leaf": self.min_child_weight,
+            "min_data_in_leaf": self.min_child_samples,
+            "bagging_fraction": self.subsample,
+            "bagging_freq": self.subsample_freq,
+            "feature_fraction": self.colsample_bytree,
+            "lambda_l1": self.reg_alpha,
+            "lambda_l2": self.reg_lambda,
+            "verbosity": -1,
+        }
+        if self.random_state is not None:
+            p["seed"] = int(self.random_state) if not hasattr(
+                self.random_state, "randint") else \
+                int(self.random_state.randint(0, 2 ** 31))
+        p.update(self._other_params)
+        return p
+
+    # -- fitting ---------------------------------------------------------
+    def _sample_weight_with_class_weight(self, y, sample_weight):
+        if self.class_weight is None:
+            return sample_weight
+        classes, counts = np.unique(y, return_counts=True)
+        if self.class_weight == "balanced":
+            cw = {c: len(y) / (len(classes) * cnt)
+                  for c, cnt in zip(classes, counts)}
+        else:
+            cw = dict(self.class_weight)
+        w = np.array([cw.get(v, 1.0) for v in y], np.float64)
+        if sample_weight is not None:
+            w = w * np.asarray(sample_weight, np.float64)
+        return w
+
+    def fit(self, X, y, sample_weight=None, init_score=None, group=None,
+            eval_set=None, eval_names=None, eval_sample_weight=None,
+            eval_init_score=None, eval_group=None, eval_metric=None,
+            feature_name="auto", categorical_feature="auto",
+            callbacks=None) -> "LGBMModel":
+        params = self._lgb_params()
+        if eval_metric is not None:
+            params["metric"] = eval_metric
+        sample_weight = self._sample_weight_with_class_weight(y, sample_weight)
+
+        train_set = Dataset(X, label=y, weight=sample_weight, group=group,
+                            init_score=init_score, feature_name=feature_name,
+                            categorical_feature=categorical_feature,
+                            params=params)
+        valid_sets: List[Dataset] = []
+        valid_names: List[str] = []
+        if eval_set is not None:
+            if isinstance(eval_set, tuple):
+                eval_set = [eval_set]
+            for i, (vx, vy) in enumerate(eval_set):
+                vw = (eval_sample_weight[i]
+                      if eval_sample_weight is not None else None)
+                vg = eval_group[i] if eval_group is not None else None
+                vi = (eval_init_score[i]
+                      if eval_init_score is not None else None)
+                if np.shares_memory(np.asarray(vx), np.asarray(X)) or \
+                        (np.asarray(vx).shape == np.asarray(X).shape and
+                         np.array_equal(np.asarray(vx, np.float64),
+                                        np.asarray(X, np.float64))):
+                    valid_sets.append(train_set)
+                else:
+                    valid_sets.append(Dataset(
+                        vx, label=vy, weight=vw, group=vg, init_score=vi,
+                        reference=train_set, params=params))
+                valid_names.append(
+                    eval_names[i] if eval_names else f"valid_{i}")
+
+        self._Booster = train_fn(params, train_set,
+                                 num_boost_round=self.n_estimators,
+                                 valid_sets=valid_sets,
+                                 valid_names=valid_names,
+                                 callbacks=callbacks)
+        self._n_features = np.asarray(X).shape[1]
+        self.fitted_ = True
+        return self
+
+    # -- introspection ---------------------------------------------------
+    @property
+    def booster_(self) -> Booster:
+        self._check_fitted()
+        return self._Booster
+
+    @property
+    def n_features_(self) -> int:
+        self._check_fitted()
+        return self._n_features
+
+    @property
+    def n_features_in_(self) -> int:
+        return self.n_features_
+
+    @property
+    def best_iteration_(self) -> int:
+        self._check_fitted()
+        return self._Booster.best_iteration
+
+    @property
+    def best_score_(self):
+        self._check_fitted()
+        return self._Booster.best_score
+
+    @property
+    def feature_importances_(self) -> np.ndarray:
+        self._check_fitted()
+        return self._Booster.feature_importance(self.importance_type)
+
+    @property
+    def feature_name_(self) -> List[str]:
+        self._check_fitted()
+        return self._Booster.feature_name()
+
+    def _check_fitted(self):
+        if not self.fitted_:
+            raise LightGBMError("Estimator not fitted; call fit first")
+
+    def predict(self, X, raw_score: bool = False, start_iteration: int = 0,
+                num_iteration: int = -1, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs):
+        self._check_fitted()
+        return self._Booster.predict(
+            X, raw_score=raw_score, start_iteration=start_iteration,
+            num_iteration=num_iteration, pred_leaf=pred_leaf,
+            pred_contrib=pred_contrib)
+
+
+class LGBMRegressor(LGBMModel):
+    """(ref: sklearn.py:1409)"""
+
+    def fit(self, X, y, **kwargs) -> "LGBMRegressor":
+        if self._objective is None:
+            self._objective = "regression"
+        super().fit(X, y, **kwargs)
+        return self
+
+    def score(self, X, y, sample_weight=None) -> float:
+        pred = self.predict(X)
+        y = np.asarray(y, np.float64)
+        u = np.sum((y - pred) ** 2)
+        v = np.sum((y - y.mean()) ** 2)
+        return 1.0 - u / v if v > 0 else 0.0
+
+
+class LGBMClassifier(LGBMModel):
+    """(ref: sklearn.py:1524)"""
+
+    def fit(self, X, y, **kwargs) -> "LGBMClassifier":
+        y = np.asarray(y)
+        self._classes = np.unique(y)
+        self._n_classes = len(self._classes)
+        y_enc = np.searchsorted(self._classes, y).astype(np.float64)
+        if self._objective is None:
+            self._objective = ("binary" if self._n_classes <= 2
+                               else "multiclass")
+        params_extra = {}
+        if self._n_classes > 2:
+            self._other_params.setdefault("num_class", self._n_classes)
+        super().fit(X, y_enc, **kwargs)
+        del params_extra
+        return self
+
+    @property
+    def classes_(self):
+        self._check_fitted()
+        return self._classes
+
+    @property
+    def n_classes_(self) -> int:
+        self._check_fitted()
+        return self._n_classes
+
+    def predict_proba(self, X, **kwargs) -> np.ndarray:
+        prob = super().predict(X, **kwargs)
+        if prob.ndim == 1:
+            prob = np.column_stack([1.0 - prob, prob])
+        return prob
+
+    def predict(self, X, raw_score: bool = False, pred_leaf: bool = False,
+                pred_contrib: bool = False, **kwargs):
+        if raw_score or pred_leaf or pred_contrib:
+            return super().predict(X, raw_score=raw_score,
+                                   pred_leaf=pred_leaf,
+                                   pred_contrib=pred_contrib, **kwargs)
+        prob = self.predict_proba(X, **kwargs)
+        return self._classes[np.argmax(prob, axis=1)]
+
+    def score(self, X, y, sample_weight=None) -> float:
+        return float(np.mean(self.predict(X) == np.asarray(y)))
+
+
+class LGBMRanker(LGBMModel):
+    """(ref: sklearn.py:1832)"""
+
+    def fit(self, X, y, group=None, **kwargs) -> "LGBMRanker":
+        if group is None:
+            raise LightGBMError("LGBMRanker.fit requires group")
+        if self._objective is None:
+            self._objective = "lambdarank"
+        super().fit(X, y, group=group, **kwargs)
+        return self

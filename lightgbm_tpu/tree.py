@@ -116,8 +116,7 @@ class Tree:
         nwords = max_val // 32 + 1
         bits = [0] * nwords
         bits[max_val // 32] |= 1 << (max_val % 32)
-        self.threshold_bin[node] = self.num_cat
-        self.threshold[node] = self.num_cat
+        self.threshold[node] = self.num_cat  # threshold_bin keeps the bin
         self.cat_boundaries.append(self.cat_boundaries[-1] + nwords)
         self.cat_threshold.extend(bits)
         self.num_cat += 1

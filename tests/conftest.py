@@ -1,0 +1,64 @@
+"""Test configuration: force an 8-device virtual CPU mesh so sharding
+paths are exercised without TPU hardware (the driver separately dry-runs
+the multi-chip path via __graft_entry__.dryrun_multichip)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the axon TPU plugin overrides JAX_PLATFORMS; force CPU explicitly
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+def make_regression(n=1000, f=8, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = (X[:, 0] * 2.0 - X[:, 1] + 0.5 * X[:, 2] ** 2
+         + 0.1 * r.randn(n)).astype(np.float32)
+    return X, y
+
+
+def make_binary(n=1000, f=8, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    logit = X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.3 * X[:, 2] * X[:, 3]
+    y = (logit + 0.2 * r.randn(n) > 0.5).astype(np.float32)
+    return X, y
+
+
+def make_multiclass(n=1200, f=8, k=4, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    centers = r.randn(k, f) * 2.0
+    d = ((X[:, None, :] - centers[None]) ** 2).sum(-1)
+    y = np.argmin(d + 0.5 * r.randn(n, k), axis=1).astype(np.float32)
+    return X, y
+
+
+def make_ranking(num_queries=50, docs_per_query=20, f=6, seed=0):
+    r = np.random.RandomState(seed)
+    n = num_queries * docs_per_query
+    X = r.randn(n, f)
+    rel = X[:, 0] + 0.5 * X[:, 1] + 0.3 * r.randn(n)
+    y = np.zeros(n, np.float32)
+    for q in range(num_queries):
+        s = q * docs_per_query
+        seg = rel[s:s + docs_per_query]
+        qs = np.quantile(seg, [0.5, 0.75, 0.9])
+        y[s:s + docs_per_query] = np.digitize(seg, qs)
+    group = np.full(num_queries, docs_per_query)
+    return X, y, group

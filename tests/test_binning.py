@@ -1,0 +1,112 @@
+"""Binning semantics tests (ref strategy: tests/cpp_tests + binning parts
+of tests/python_package_test/test_basic.py)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.binning import (BinMapper, MISSING_NAN, MISSING_NONE,
+                                  MISSING_ZERO)
+
+
+def test_few_distinct_values_one_bin_each():
+    vals = np.array([1.0, 2.0, 3.0] * 50)
+    m = BinMapper().fit(vals, max_bin=255, min_data_in_bin=1)
+    b = m.transform(np.array([1.0, 2.0, 3.0]))
+    assert len(set(b.tolist())) == 3
+    assert m.missing_type == MISSING_NONE
+
+
+def test_bin_bounds_monotone():
+    rng = np.random.RandomState(0)
+    vals = rng.randn(10000)
+    m = BinMapper().fit(vals, max_bin=63)
+    assert np.all(np.diff(m.bin_upper_bound) > 0)
+    assert m.num_bins <= 64
+    # transform respects bounds: value <= ub -> that bin
+    b = m.transform(vals)
+    assert b.min() >= 0 and b.max() < m.num_bins
+
+
+def test_equal_count_binning():
+    rng = np.random.RandomState(1)
+    vals = rng.rand(100000) + 1.0  # no zeros
+    m = BinMapper().fit(vals, max_bin=16)
+    b = m.transform(vals)
+    counts = np.bincount(b, minlength=m.num_bins)
+    nonzero = counts[counts > 0]
+    # roughly equal-count bins
+    assert nonzero.max() / max(nonzero.mean(), 1) < 2.5
+
+
+def test_zero_gets_own_bin():
+    vals = np.concatenate([np.zeros(500), np.random.RandomState(2).randn(500)])
+    m = BinMapper().fit(vals, max_bin=32)
+    zb = m.transform(np.array([0.0]))[0]
+    near = m.transform(np.array([1e-40, -1e-40]))
+    assert (near == zb).all()
+    assert m.default_bin == zb
+
+
+def test_nan_missing_gets_last_bin():
+    vals = np.array([1.0, 2.0, np.nan, 3.0, np.nan] * 20)
+    m = BinMapper().fit(vals, max_bin=32)
+    assert m.missing_type == MISSING_NAN
+    b = m.transform(np.array([np.nan]))
+    assert b[0] == m.num_bins - 1
+
+
+def test_zero_as_missing():
+    vals = np.array([0.0, 1.0, 2.0, np.nan] * 25)
+    m = BinMapper().fit(vals, max_bin=32, zero_as_missing=True)
+    assert m.missing_type == MISSING_ZERO
+    assert m.transform(np.array([np.nan]))[0] == \
+        m.transform(np.array([0.0]))[0]
+
+
+def test_heavy_hitter_isolated():
+    rng = np.random.RandomState(3)
+    vals = np.concatenate([np.full(50000, 7.5), rng.rand(1000) * 10 + 10])
+    m = BinMapper().fit(vals, max_bin=8)
+    b_hh = m.transform(np.array([7.5]))[0]
+    b_near = m.transform(np.array([10.4]))[0]
+    assert b_hh != b_near
+
+
+def test_categorical_mapping():
+    vals = np.array([3.0] * 100 + [7.0] * 50 + [1.0] * 10 + [9.0] * 2)
+    m = BinMapper().fit(vals, max_bin=32, is_categorical=True)
+    assert m.is_categorical
+    b3 = m.transform(np.array([3.0]))[0]
+    b7 = m.transform(np.array([7.0]))[0]
+    assert b3 == 1  # most frequent category is bin 1 (bin 0 = other)
+    assert b7 == 2
+    assert m.transform(np.array([555.0]))[0] == 0  # unseen -> other
+    assert float(m.bin_to_value(b3)) == 3.0
+
+
+def test_categorical_negative_is_missing():
+    vals = np.array([1.0, 2.0, -1.0] * 30)
+    m = BinMapper().fit(vals, max_bin=8, is_categorical=True)
+    assert m.transform(np.array([-5.0]))[0] == 0
+
+
+def test_trivial_feature():
+    m = BinMapper().fit(np.full(100, 3.14), max_bin=255)
+    assert m.is_trivial
+
+
+def test_forced_bounds():
+    vals = np.random.RandomState(4).rand(1000) * 10
+    m = BinMapper().fit(vals, max_bin=255, forced_bounds=[2.5, 5.0, 7.5])
+    assert 2.5 in m.bin_upper_bound and 5.0 in m.bin_upper_bound
+    assert m.transform(np.array([2.4]))[0] != m.transform(np.array([2.6]))[0]
+
+
+def test_bin_to_value_roundtrip():
+    rng = np.random.RandomState(5)
+    vals = rng.randn(5000)
+    m = BinMapper().fit(vals, max_bin=64)
+    for b in range(m.num_bins - 1):
+        ub = m.bin_to_value(b)
+        if np.isfinite(ub):
+            assert m.transform(np.array([ub]))[0] == b

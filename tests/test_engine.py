@@ -1,0 +1,344 @@
+"""End-to-end training semantics (ref strategy:
+tests/python_package_test/test_engine.py)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from tests.conftest import (make_binary, make_multiclass, make_ranking,
+                            make_regression)
+
+
+def _split(X, y, frac=0.75):
+    n = int(len(X) * frac)
+    return X[:n], y[:n], X[n:], y[n:]
+
+
+class TestRegression:
+    def test_l2_learning(self):
+        X, y = make_regression(1200)
+        Xt, yt, Xv, yv = _split(X, y)
+        dtrain = lgb.Dataset(Xt, label=yt)
+        bst = lgb.train({"objective": "regression", "num_leaves": 31,
+                         "learning_rate": 0.1, "min_data_in_leaf": 5,
+                         "verbosity": -1},
+                        dtrain, num_boost_round=50)
+        pred = bst.predict(Xv)
+        mse = np.mean((pred - yv) ** 2)
+        base = np.mean((yv - yt.mean()) ** 2)
+        assert mse < base * 0.2
+
+    def test_l1_objective(self):
+        X, y = make_regression(800)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression_l1", "num_leaves": 15,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        dtrain, num_boost_round=30)
+        mae = np.mean(np.abs(bst.predict(X) - y))
+        base = np.mean(np.abs(y - np.median(y)))
+        assert mae < base * 0.5
+
+    def test_training_loss_decreases(self):
+        X, y = make_regression(600)
+        dtrain = lgb.Dataset(X, label=y)
+        record = {}
+        lgb.train({"objective": "regression", "metric": "l2",
+                   "num_leaves": 15, "verbosity": -1,
+                   "is_provide_training_metric": True},
+                  dtrain, num_boost_round=20,
+                  valid_sets=[dtrain], valid_names=["training"],
+                  callbacks=[lgb.record_evaluation(record)])
+        losses = record["training"]["l2"]
+        assert losses[-1] < losses[0] * 0.5
+        assert all(b <= a * 1.001 for a, b in zip(losses, losses[1:]))
+
+    def test_poisson(self):
+        rng = np.random.RandomState(0)
+        X = rng.randn(800, 5)
+        y = rng.poisson(np.exp(0.5 * X[:, 0] + 0.2 * X[:, 1])).astype(float)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "poisson", "num_leaves": 15,
+                         "verbosity": -1}, dtrain, num_boost_round=40)
+        pred = bst.predict(X)
+        assert np.all(pred > 0)  # ConvertOutput = exp
+        assert np.corrcoef(pred, y)[0, 1] > 0.5
+
+
+class TestBinary:
+    def test_auc_quality(self):
+        X, y = make_binary(2000)
+        Xt, yt, Xv, yv = _split(X, y)
+        dtrain = lgb.Dataset(Xt, label=yt)
+        dvalid = lgb.Dataset(Xv, label=yv, reference=dtrain)
+        record = {}
+        bst = lgb.train({"objective": "binary", "metric": "auc",
+                         "num_leaves": 31, "min_data_in_leaf": 5,
+                         "verbosity": -1},
+                        dtrain, num_boost_round=40, valid_sets=[dvalid],
+                        callbacks=[lgb.record_evaluation(record)])
+        assert record["valid_0"]["auc"][-1] > 0.92
+        pred = bst.predict(Xv)
+        assert pred.min() >= 0 and pred.max() <= 1
+
+    def test_boost_from_average_init(self):
+        X, y = make_binary(500)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "verbosity": -1},
+                        dtrain, num_boost_round=1, )
+        # raw prediction at iteration 1 includes the init bias
+        raw = bst.predict(X, raw_score=True)
+        prior = np.log(y.mean() / (1 - y.mean()))
+        assert abs(raw.mean() - prior) < 0.5
+
+    def test_early_stopping(self):
+        X, y = make_binary(1500)
+        Xt, yt, Xv, yv = _split(X, y)
+        dtrain = lgb.Dataset(Xt, label=yt)
+        dvalid = lgb.Dataset(Xv, label=yv, reference=dtrain)
+        bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                         "num_leaves": 63, "min_data_in_leaf": 2,
+                         "learning_rate": 0.3, "verbosity": -1},
+                        dtrain, num_boost_round=200, valid_sets=[dvalid],
+                        callbacks=[lgb.early_stopping(5, verbose=False)])
+        assert bst.best_iteration < 200
+
+    def test_weights_change_model(self):
+        X, y = make_binary(600)
+        w = np.where(y > 0, 10.0, 1.0)
+        d1 = lgb.Dataset(X, label=y)
+        d2 = lgb.Dataset(X, label=y, weight=w)
+        p1 = lgb.train({"objective": "binary", "verbosity": -1}, d1,
+                       num_boost_round=5).predict(X)
+        p2 = lgb.train({"objective": "binary", "verbosity": -1}, d2,
+                       num_boost_round=5).predict(X)
+        assert p2.mean() > p1.mean()  # upweighted positives -> higher probs
+
+
+class TestMulticlass:
+    def test_softmax(self):
+        X, y = make_multiclass(1500, k=4)
+        Xt, yt, Xv, yv = _split(X, y)
+        dtrain = lgb.Dataset(Xt, label=yt)
+        bst = lgb.train({"objective": "multiclass", "num_class": 4,
+                         "num_leaves": 15, "min_data_in_leaf": 5,
+                         "verbosity": -1},
+                        dtrain, num_boost_round=30)
+        pred = bst.predict(Xv)
+        assert pred.shape == (len(Xv), 4)
+        np.testing.assert_allclose(pred.sum(1), 1.0, rtol=1e-5)
+        acc = (np.argmax(pred, 1) == yv).mean()
+        assert acc > 0.8
+
+    def test_ova(self):
+        X, y = make_multiclass(900, k=3)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "multiclassova", "num_class": 3,
+                         "num_leaves": 15, "verbosity": -1},
+                        dtrain, num_boost_round=20)
+        pred = bst.predict(X)
+        acc = (np.argmax(pred, 1) == y).mean()
+        assert acc > 0.85
+
+
+class TestRanking:
+    def test_lambdarank_improves_ndcg(self):
+        X, y, group = make_ranking(60, 20)
+        dtrain = lgb.Dataset(X, label=y, group=group)
+        record = {}
+        lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                   "eval_at": [5], "num_leaves": 15, "min_data_in_leaf": 2,
+                   "verbosity": -1, "is_provide_training_metric": True},
+                  dtrain, num_boost_round=30, valid_sets=[dtrain],
+                  valid_names=["training"],
+                  callbacks=[lgb.record_evaluation(record)])
+        ndcgs = record["training"]["ndcg@5"]
+        assert ndcgs[-1] > 0.75
+        assert ndcgs[-1] > ndcgs[0]
+
+    def test_xendcg(self):
+        X, y, group = make_ranking(60, 20)
+        dtrain = lgb.Dataset(X, label=y, group=group)
+        record = {}
+        lgb.train({"objective": "rank_xendcg", "metric": "ndcg",
+                   "eval_at": [5], "num_leaves": 15, "min_data_in_leaf": 2,
+                   "verbosity": -1, "is_provide_training_metric": True},
+                  dtrain, num_boost_round=30, valid_sets=[dtrain],
+                  valid_names=["training"],
+                  callbacks=[lgb.record_evaluation(record)])
+        assert record["training"]["ndcg@5"][-1] > 0.7
+
+
+class TestSampling:
+    def test_bagging(self):
+        X, y = make_binary(1000)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "bagging_fraction": 0.5,
+                         "bagging_freq": 1, "num_leaves": 15,
+                         "verbosity": -1}, dtrain, num_boost_round=20)
+        from lightgbm_tpu.metrics import _auc
+        assert _auc(y, bst.predict(X)) > 0.85
+
+    def test_goss(self):
+        X, y = make_binary(1000)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary",
+                         "data_sample_strategy": "goss",
+                         "num_leaves": 15, "verbosity": -1},
+                        dtrain, num_boost_round=20)
+        from lightgbm_tpu.metrics import _auc
+        assert _auc(y, bst.predict(X)) > 0.85
+
+    def test_goss_via_boosting_alias(self):
+        X, y = make_binary(600)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "boosting": "goss",
+                         "num_leaves": 7, "verbosity": -1},
+                        dtrain, num_boost_round=5)
+        assert bst.num_trees() == 5
+
+    def test_feature_fraction(self):
+        X, y = make_binary(800)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "feature_fraction": 0.5,
+                         "num_leaves": 15, "verbosity": -1},
+                        dtrain, num_boost_round=20)
+        from lightgbm_tpu.metrics import _auc
+        assert _auc(y, bst.predict(X)) > 0.8
+
+
+class TestBoostingVariants:
+    def test_dart(self):
+        X, y = make_regression(600)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "boosting": "dart",
+                         "num_leaves": 15, "verbosity": -1},
+                        dtrain, num_boost_round=20)
+        mse = np.mean((bst.predict(X) - y) ** 2)
+        assert mse < np.var(y) * 0.5
+
+    def test_rf(self):
+        X, y = make_binary(800)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "binary", "boosting": "rf",
+                         "bagging_fraction": 0.7, "bagging_freq": 1,
+                         "num_leaves": 31, "min_data_in_leaf": 5,
+                         "verbosity": -1},
+                        dtrain, num_boost_round=20)
+        from lightgbm_tpu.metrics import _auc
+        pred = bst.predict(X)
+        assert _auc(y, pred) > 0.85
+        assert pred.min() >= 0 and pred.max() <= 1
+
+
+class TestAPI:
+    def test_cv(self):
+        X, y = make_binary(600)
+        dtrain = lgb.Dataset(X, label=y)
+        res = lgb.cv({"objective": "binary", "metric": "auc",
+                      "num_leaves": 7, "verbosity": -1},
+                     dtrain, num_boost_round=10, nfold=3)
+        key = [k for k in res if k.endswith("-mean")][0]
+        assert len(res[key]) == 10
+        assert res[key][-1] > 0.8
+
+    def test_custom_objective(self):
+        X, y = make_regression(500)
+
+        def fobj(preds, dataset):
+            labels = np.asarray(dataset.get_label())
+            return preds - labels, np.ones_like(preds)
+
+        # custom fobj path through Booster.update (objective=none)
+        bst2 = lgb.Booster({"objective": "none", "num_leaves": 15,
+                            "verbosity": -1}, lgb.Dataset(X, label=y))
+        for _ in range(20):
+            bst2.update(fobj=fobj)
+        mse = np.mean((bst2.predict(X, raw_score=True) - y) ** 2)
+        assert mse < np.var(y) * 0.3
+
+    def test_custom_feval(self):
+        X, y = make_binary(400)
+        dtrain = lgb.Dataset(X, label=y)
+        seen = []
+
+        def feval(preds, dataset):
+            seen.append(len(preds))
+            return "my_metric", 1.23, True
+
+        record = {}
+        lgb.train({"objective": "binary", "metric": "none",
+                   "num_leaves": 7, "verbosity": -1},
+                  dtrain, num_boost_round=3,
+                  valid_sets=[lgb.Dataset(X, label=y, reference=dtrain)],
+                  feval=feval, callbacks=[lgb.record_evaluation(record)])
+        assert seen
+        assert record["valid_0"]["my_metric"] == [1.23] * 3
+
+    def test_feature_importance(self):
+        X, y = make_regression(600)
+        dtrain = lgb.Dataset(X, label=y)
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "verbosity": -1}, dtrain, num_boost_round=10)
+        imp_split = bst.feature_importance("split")
+        imp_gain = bst.feature_importance("gain")
+        assert imp_split.sum() > 0
+        # features 0,1,2 are the signal
+        assert imp_gain[:3].sum() > imp_gain[3:].sum()
+
+    def test_reset_parameter_callback(self):
+        X, y = make_regression(400)
+        dtrain = lgb.Dataset(X, label=y)
+        lrs = [0.3, 0.2, 0.1]
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbosity": -1}, dtrain, num_boost_round=3,
+                        callbacks=[lgb.reset_parameter(learning_rate=lrs)])
+        assert bst.num_trees() == 3
+
+    def test_rollback(self):
+        X, y = make_regression(300)
+        bst = lgb.Booster({"objective": "regression", "num_leaves": 7,
+                           "verbosity": -1}, lgb.Dataset(X, label=y))
+        for _ in range(3):
+            bst.update()
+        assert bst.current_iteration() == 3
+        bst.rollback_one_iter()
+        assert bst.current_iteration() == 2
+
+    def test_pred_leaf(self):
+        X, y = make_regression(300)
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=4)
+        leaves = bst.predict(X, pred_leaf=True)
+        assert leaves.shape == (300, 4)
+        assert leaves.max() < 7
+
+    def test_monotone_constraints(self):
+        rng = np.random.RandomState(0)
+        X = rng.rand(800, 2)
+        y = 2 * X[:, 0] + 0.1 * rng.randn(800)
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "monotone_constraints": [1, 0], "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+        # predictions must be monotone increasing in feature 0
+        grid = np.linspace(0.05, 0.95, 20)
+        test = np.column_stack([grid, np.full(20, 0.5)])
+        pred = bst.predict(test)
+        assert np.all(np.diff(pred) >= -1e-6)
+
+
+class TestCategorical:
+    def test_categorical_feature(self):
+        rng = np.random.RandomState(1)
+        n = 1000
+        cat = rng.randint(0, 5, n).astype(np.float64)
+        noise = rng.randn(n)
+        effect = np.array([0.0, 3.0, -2.0, 5.0, 1.0])
+        y = effect[cat.astype(int)] + 0.1 * rng.randn(n)
+        X = np.column_stack([cat, noise])
+        dtrain = lgb.Dataset(X, label=y, categorical_feature=[0])
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        dtrain, num_boost_round=30)
+        mse = np.mean((bst.predict(X) - y) ** 2)
+        assert mse < np.var(y) * 0.1

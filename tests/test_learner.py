@@ -1,0 +1,247 @@
+"""Tree learner unit tests: histogram math, split finding, growth
+(ref strategy: the CUDA learner decomposition, SURVEY.md §2.4)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.histogram import build_histogram, subtract_histogram
+from lightgbm_tpu.ops.split import (FeatureMeta, SplitHyperParams,
+                                    find_best_split, leaf_output,
+                                    threshold_l1)
+from lightgbm_tpu.learner import grow_tree
+from lightgbm_tpu.config import Config
+
+
+def _meta(num_bins, missing=None, cat=None):
+    f = len(num_bins)
+    return FeatureMeta(
+        num_bins=jnp.asarray(num_bins, jnp.int32),
+        missing_type=jnp.asarray(missing if missing is not None
+                                 else [0] * f, jnp.int32),
+        default_bin=jnp.asarray([0] * f, jnp.int32),
+        is_categorical=jnp.asarray(cat if cat is not None else [False] * f),
+        monotone=jnp.asarray([0] * f, jnp.int8),
+        penalty=jnp.asarray([1.0] * f, jnp.float32),
+    )
+
+
+def _hp(**kw):
+    cfg = Config()
+    for k, v in kw.items():
+        setattr(cfg, k, v)
+    return SplitHyperParams.from_config(cfg)
+
+
+class TestHistogram:
+    def test_matches_numpy(self):
+        rng = np.random.RandomState(0)
+        n, f, b = 500, 4, 16
+        bins = rng.randint(0, b, (f, n)).astype(np.uint8)
+        g = rng.randn(n).astype(np.float32)
+        h = rng.rand(n).astype(np.float32)
+        mask = (rng.rand(n) > 0.3).astype(np.float32)
+        hist = np.asarray(build_histogram(jnp.asarray(bins), jnp.asarray(g),
+                                          jnp.asarray(h), jnp.asarray(mask),
+                                          max_bins=b))
+        for fi in range(f):
+            for bi in range(b):
+                sel = (bins[fi] == bi) & (mask > 0)
+                np.testing.assert_allclose(hist[fi, bi, 0], g[sel].sum(),
+                                           rtol=1e-4, atol=1e-4)
+                np.testing.assert_allclose(hist[fi, bi, 1], h[sel].sum(),
+                                           rtol=1e-4, atol=1e-4)
+                np.testing.assert_allclose(hist[fi, bi, 2], sel.sum(),
+                                           rtol=1e-5)
+
+    def test_chunked_matches_unchunked(self):
+        rng = np.random.RandomState(1)
+        n, f, b = 1000, 3, 8
+        bins = jnp.asarray(rng.randint(0, b, (f, n)).astype(np.uint8))
+        g = jnp.asarray(rng.randn(n).astype(np.float32))
+        h = jnp.ones(n, jnp.float32)
+        m = jnp.ones(n, jnp.float32)
+        h1 = build_histogram(bins, g, h, m, max_bins=b)
+        h2 = build_histogram(bins, g, h, m, max_bins=b, row_chunk=256)
+        np.testing.assert_allclose(np.asarray(h1), np.asarray(h2),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_subtraction(self):
+        rng = np.random.RandomState(2)
+        parent = jnp.asarray(rng.rand(2, 8, 3).astype(np.float32)) + 1.0
+        child = parent * 0.4
+        sib = subtract_histogram(parent, child)
+        np.testing.assert_allclose(np.asarray(sib), np.asarray(parent) * 0.6,
+                                   rtol=1e-5)
+
+
+class TestSplitFinder:
+    def test_finds_obvious_split(self):
+        # feature 0: clean signal, feature 1: noise
+        n, b = 1000, 8
+        rng = np.random.RandomState(3)
+        bins0 = (np.arange(n) % b).astype(np.uint8)
+        bins1 = rng.randint(0, b, n).astype(np.uint8)
+        g = np.where(bins0 < 4, -1.0, 1.0).astype(np.float32)
+        h = np.ones(n, np.float32)
+        hist = build_histogram(jnp.asarray(np.stack([bins0, bins1])),
+                               jnp.asarray(g), jnp.asarray(h),
+                               jnp.ones(n, jnp.float32), max_bins=b)
+        info = find_best_split(hist, jnp.float32(g.sum()), jnp.float32(n),
+                               jnp.float32(n), _meta([b, b]),
+                               _hp(min_data_in_leaf=1), jnp.ones(2, bool))
+        assert int(info.feature) == 0
+        assert int(info.threshold) == 3
+        assert float(info.gain) > 0
+        assert float(info.left_count) == pytest.approx(n / 2)
+
+    def test_min_data_constraint(self):
+        n, b = 100, 4
+        bins = np.zeros((1, n), np.uint8)
+        bins[0, :5] = 1  # only 5 rows on one side
+        g = np.where(bins[0] == 1, -5.0, 1.0).astype(np.float32)
+        hist = build_histogram(jnp.asarray(bins), jnp.asarray(g),
+                               jnp.ones(n, jnp.float32),
+                               jnp.ones(n, jnp.float32), max_bins=b)
+        info = find_best_split(hist, jnp.float32(g.sum()), jnp.float32(n),
+                               jnp.float32(n), _meta([b]),
+                               _hp(min_data_in_leaf=10), jnp.ones(1, bool))
+        assert float(info.gain) <= 0  # blocked by min_data_in_leaf
+
+    def test_lambda_l1_threshold(self):
+        assert float(threshold_l1(jnp.float32(5.0), jnp.float32(2.0))) == 3.0
+        assert float(threshold_l1(jnp.float32(-5.0), jnp.float32(2.0))) == -3.0
+        assert float(threshold_l1(jnp.float32(1.0), jnp.float32(2.0))) == 0.0
+
+    def test_leaf_output_l2(self):
+        hp = _hp(lambda_l2=1.0)
+        out = leaf_output(jnp.float32(10.0), jnp.float32(4.0), hp)
+        assert float(out) == pytest.approx(-10.0 / 5.0)
+
+    def test_missing_nan_dual_direction(self):
+        # NaN rows (last bin) carry strong negative gradient -> want them
+        # grouped with low bins (default_left with nan-left variant)
+        n, b = 300, 5
+        bins = np.zeros((1, n), np.uint8)
+        bins[0, :100] = 0
+        bins[0, 100:200] = 1
+        bins[0, 200:] = b - 1  # NaN bin
+        g = np.concatenate([-np.ones(100), np.ones(100), -np.ones(100)]) \
+            .astype(np.float32)
+        hist = build_histogram(jnp.asarray(bins), jnp.asarray(g),
+                               jnp.ones(n, jnp.float32),
+                               jnp.ones(n, jnp.float32), max_bins=b)
+        info = find_best_split(hist, jnp.float32(g.sum()), jnp.float32(n),
+                               jnp.float32(n), _meta([b], missing=[2]),
+                               _hp(min_data_in_leaf=1), jnp.ones(1, bool))
+        assert float(info.gain) > 0
+        assert bool(info.default_left)  # nan joins the negative side
+        assert int(info.threshold) == 0
+        assert float(info.left_count) == pytest.approx(200)
+
+    def test_feature_mask(self):
+        n, b = 200, 4
+        bins0 = (np.arange(n) % b).astype(np.uint8)
+        g = np.where(bins0 < 2, -1.0, 1.0).astype(np.float32)
+        hist = build_histogram(jnp.asarray(bins0[None]), jnp.asarray(g),
+                               jnp.ones(n, jnp.float32),
+                               jnp.ones(n, jnp.float32), max_bins=b)
+        info = find_best_split(hist, jnp.float32(g.sum()), jnp.float32(n),
+                               jnp.float32(n), _meta([b]),
+                               _hp(min_data_in_leaf=1),
+                               jnp.zeros(1, bool))
+        assert float(info.gain) <= 0
+
+
+class TestGrowTree:
+    def _grow(self, bins, g, h, num_leaves=7, **hp_kw):
+        f, n = bins.shape
+        b = int(bins.max()) + 1
+        meta = _meta([b] * f)
+        return grow_tree(jnp.asarray(bins), jnp.asarray(g), jnp.asarray(h),
+                         jnp.ones(n, jnp.float32), jnp.ones(f, bool),
+                         meta, _hp(**hp_kw), jnp.int32(-1),
+                         num_leaves=num_leaves, max_bins=b)
+
+    def test_perfect_split_tree(self):
+        n = 400
+        bins = (np.arange(n) % 4).astype(np.uint8)[None, :]
+        y = np.array([0.0, 1.0, 2.0, 3.0])[bins[0]].astype(np.float32)
+        g = (0.0 - y).astype(np.float32)  # L2 grad at score 0
+        rec, row_leaf = self._grow(bins, g, np.ones(n, np.float32),
+                                   num_leaves=4, min_data_in_leaf=1)
+        assert int(rec.num_leaves) == 4
+        # each bin gets its own leaf with value == its label mean
+        leaves = np.asarray(row_leaf)
+        values = np.asarray(rec.leaf_value)
+        for b in range(4):
+            leaf_ids = np.unique(leaves[bins[0] == b])
+            assert len(leaf_ids) == 1
+            assert values[leaf_ids[0]] == pytest.approx(float(b), abs=1e-3)
+
+    def test_gain_ordering_leafwise(self):
+        # two features; feature 0 has much higher gain -> split first
+        n = 800
+        rng = np.random.RandomState(7)
+        f0 = rng.randint(0, 2, n).astype(np.uint8)
+        f1 = rng.randint(0, 2, n).astype(np.uint8)
+        y = 10.0 * f0 + 1.0 * f1
+        g = (0.0 - y).astype(np.float32)
+        rec, _ = self._grow(np.stack([f0, f1]), g, np.ones(n, np.float32),
+                            num_leaves=4, min_data_in_leaf=1)
+        assert int(np.asarray(rec.split_feature)[0]) == 0
+
+    def test_stops_when_no_gain(self):
+        n = 100
+        bins = np.zeros((1, n), np.uint8)  # nothing to split on
+        g = np.random.RandomState(8).randn(n).astype(np.float32)
+        rec, _ = self._grow(bins, g, np.ones(n, np.float32), num_leaves=8)
+        assert int(rec.num_leaves) == 1
+
+    def test_max_depth(self):
+        n = 512
+        rng = np.random.RandomState(9)
+        bins = rng.randint(0, 8, (3, n)).astype(np.uint8)
+        y = bins.sum(0).astype(np.float32)
+        g = -y
+        f, _ = bins.shape
+        meta = _meta([8] * f)
+        rec, _ = grow_tree(jnp.asarray(bins), jnp.asarray(g),
+                           jnp.ones(n, jnp.float32),
+                           jnp.ones(n, jnp.float32), jnp.ones(f, bool),
+                           meta, _hp(min_data_in_leaf=1), jnp.int32(2),
+                           num_leaves=31, max_bins=8)
+        # depth <= 2 means at most 4 leaves
+        assert int(rec.num_leaves) <= 4
+
+    def test_leaf_counts_sum_to_n(self):
+        n = 600
+        rng = np.random.RandomState(10)
+        bins = rng.randint(0, 16, (4, n)).astype(np.uint8)
+        g = rng.randn(n).astype(np.float32)
+        rec, row_leaf = self._grow(bins, g, np.ones(n, np.float32),
+                                   num_leaves=15, min_data_in_leaf=5)
+        counts = np.asarray(rec.leaf_count)
+        nl = int(rec.num_leaves)
+        assert counts[:nl].sum() == pytest.approx(n)
+        # row_leaf consistent with leaf_count
+        bc = np.bincount(np.asarray(row_leaf), minlength=15)
+        np.testing.assert_allclose(bc[:nl], counts[:nl])
+
+    def test_histogram_subtraction_consistency(self):
+        """Grown tree leaf sums must equal direct per-leaf recomputation."""
+        n = 500
+        rng = np.random.RandomState(11)
+        bins = rng.randint(0, 8, (3, n)).astype(np.uint8)
+        g = rng.randn(n).astype(np.float32)
+        rec, row_leaf = self._grow(bins, g, np.ones(n, np.float32),
+                                   num_leaves=8, min_data_in_leaf=10)
+        leaves = np.asarray(row_leaf)
+        sums = np.asarray(rec.leaf_value)
+        nl = int(rec.num_leaves)
+        for leaf in range(nl):
+            sel = leaves == leaf
+            if sel.sum() == 0:
+                continue
+            expect = -g[sel].sum() / sel.sum()
+            assert sums[leaf] == pytest.approx(expect, abs=1e-3)

@@ -1,0 +1,132 @@
+"""Metric correctness tests (ref: src/metric/ semantics)."""
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Metadata
+from lightgbm_tpu.metrics import _auc, create_metrics
+
+
+def _eval(name, label, prob, raw=None, weight=None, group=None, **params):
+    cfg = Config.from_params({"metric": name, **params})
+    ms = create_metrics(cfg)
+    meta = Metadata(len(label))
+    meta.set_label(np.asarray(label, np.float32))
+    if weight is not None:
+        meta.set_weight(weight)
+    if group is not None:
+        meta.set_group(group)
+    ms[0].init(meta, len(label))
+    return ms[0].eval(np.asarray(prob),
+                      np.asarray(raw if raw is not None else prob))
+
+
+def test_l2_rmse():
+    y = np.array([1.0, 2.0, 3.0])
+    p = np.array([1.5, 2.0, 2.0])
+    assert _eval("l2", y, p)[0][1] == pytest.approx((0.25 + 0 + 1) / 3)
+    assert _eval("rmse", y, p)[0][1] == pytest.approx(
+        np.sqrt((0.25 + 0 + 1) / 3))
+
+
+def test_auc_perfect_and_random():
+    y = np.array([0, 0, 1, 1], np.float32)
+    assert _auc(y, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert _auc(y, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+    assert _auc(y, np.array([0.5, 0.5, 0.5, 0.5])) == 0.5
+
+
+def test_auc_matches_sklearn_formula():
+    rng = np.random.RandomState(0)
+    y = (rng.rand(500) > 0.6).astype(np.float32)
+    p = rng.rand(500) + y * 0.3
+    # rank-based reference computation
+    order = np.argsort(p)
+    ranks = np.empty(500)
+    ranks[order] = np.arange(1, 501)
+    # midrank correction for ties (none expected here)
+    npos, nneg = y.sum(), (1 - y).sum()
+    expected = (ranks[y > 0].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+    assert _auc(y, p) == pytest.approx(expected, abs=1e-10)
+
+
+def test_weighted_auc():
+    y = np.array([0, 1], np.float32)
+    p = np.array([0.3, 0.7])
+    w = np.array([2.0, 5.0])
+    assert _auc(y, p, w) == 1.0
+
+
+def test_binary_logloss():
+    y = np.array([1.0, 0.0])
+    p = np.array([0.8, 0.3])
+    expected = -(np.log(0.8) + np.log(0.7)) / 2
+    assert _eval("binary_logloss", y, p)[0][1] == pytest.approx(expected)
+
+
+def test_binary_error():
+    y = np.array([1.0, 0.0, 1.0, 0.0])
+    p = np.array([0.8, 0.3, 0.2, 0.9])
+    assert _eval("binary_error", y, p)[0][1] == pytest.approx(0.5)
+
+
+def test_multi_logloss():
+    y = np.array([0.0, 1.0])
+    prob = np.array([[0.7, 0.2, 0.1], [0.1, 0.6, 0.3]])
+    expected = -(np.log(0.7) + np.log(0.6)) / 2
+    cfg = Config.from_params({"metric": "multi_logloss", "num_class": 3,
+                              "objective": "multiclass"})
+    ms = create_metrics(cfg)
+    meta = Metadata(2)
+    meta.set_label(y)
+    ms[0].init(meta, 2)
+    assert ms[0].eval(prob, prob)[0][1] == pytest.approx(expected)
+
+
+def test_ndcg():
+    # one query, perfect ranking -> ndcg = 1
+    y = np.array([3.0, 2.0, 1.0, 0.0])
+    raw = np.array([4.0, 3.0, 2.0, 1.0])
+    res = _eval("ndcg", y, raw, group=np.array([4]), eval_at=[2, 4])
+    assert res[0][0] == "ndcg@2"
+    assert res[0][1] == pytest.approx(1.0)
+    assert res[1][1] == pytest.approx(1.0)
+    # inverted ranking -> ndcg < 1
+    res2 = _eval("ndcg", y, -raw, group=np.array([4]), eval_at=[4])
+    assert res2[0][1] < 1.0
+
+
+def test_map():
+    y = np.array([1.0, 0.0, 1.0, 0.0])
+    raw = np.array([4.0, 3.0, 2.0, 1.0])  # relevant at positions 1,3
+    res = _eval("map", y, raw, group=np.array([4]), eval_at=[4])
+    expected = (1.0 / 1.0 + 2.0 / 3.0) / 2.0
+    assert res[0][1] == pytest.approx(expected)
+
+
+def test_r2():
+    y = np.array([1.0, 2.0, 3.0, 4.0])
+    assert _eval("r2", y, y)[0][1] == pytest.approx(1.0)
+    assert _eval("r2", y, np.full(4, y.mean()))[0][1] == pytest.approx(0.0)
+
+
+def test_mape():
+    y = np.array([100.0, 200.0])
+    p = np.array([110.0, 180.0])
+    assert _eval("mape", y, p)[0][1] == pytest.approx((0.1 + 0.1) / 2)
+
+
+def test_average_precision():
+    y = np.array([1.0, 0.0, 1.0, 0.0])
+    p = np.array([0.9, 0.8, 0.7, 0.1])
+    res = _eval("average_precision", y, p)
+    expected = (1.0 + 2.0 / 3.0) / 2.0
+    assert res[0][1] == pytest.approx(expected)
+
+
+def test_higher_better_flags():
+    y = np.array([0.0, 1.0])
+    p = np.array([0.2, 0.8])
+    assert _eval("auc", y, p)[0][2] is True
+    assert _eval("binary_logloss", y, p)[0][2] is False

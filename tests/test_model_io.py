@@ -1,0 +1,153 @@
+"""Model serialization round-trips (ref strategy:
+tests/cpp_tests/test_serialize.cpp, test_engine.py save/load tests)."""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.model_io import load_model_from_string
+from tests.conftest import make_binary, make_multiclass, make_regression
+
+
+def _train_binary(n=800, rounds=10, **extra):
+    X, y = make_binary(n)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1, **extra}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=rounds)
+    return bst, X, y
+
+
+def test_string_roundtrip_predictions_match():
+    bst, X, y = _train_binary()
+    s = bst.model_to_string()
+    loaded = lgb.Booster(model_str=s)
+    np.testing.assert_allclose(loaded.predict(X), bst.predict(X),
+                               rtol=1e-9, atol=1e-10)
+    np.testing.assert_allclose(loaded.predict(X, raw_score=True),
+                               bst.predict(X, raw_score=True),
+                               rtol=1e-9, atol=1e-10)
+
+
+def test_file_roundtrip(tmp_path):
+    bst, X, y = _train_binary()
+    path = tmp_path / "model.txt"
+    bst.save_model(str(path))
+    loaded = lgb.Booster(model_file=str(path))
+    np.testing.assert_allclose(loaded.predict(X), bst.predict(X), rtol=1e-9)
+
+
+def test_model_format_header():
+    bst, X, y = _train_binary()
+    s = bst.model_to_string()
+    lines = s.splitlines()
+    assert lines[0] == "tree"
+    assert lines[1] == "version=v4"
+    assert any(l.startswith("num_class=1") for l in lines)
+    assert any(l.startswith("objective=binary sigmoid:") for l in lines)
+    assert any(l.startswith("max_feature_idx=7") for l in lines)
+    assert any(l.startswith("tree_sizes=") for l in lines)
+    assert "end of trees" in s
+    assert "feature_importances:" in s
+    assert "parameters:" in s
+    assert "end of parameters" in s
+    assert s.rstrip().endswith("pandas_categorical:null")
+
+
+def test_tree_sizes_index_correct():
+    """tree_sizes= entries must equal the byte length of each tree block
+    (ref: gbdt_model_text.cpp:369)."""
+    bst, X, y = _train_binary(rounds=3)
+    s = bst.model_to_string()
+    sizes = [int(x) for x in
+             [l for l in s.splitlines()
+              if l.startswith("tree_sizes=")][0].split("=")[1].split()]
+    # reconstruct blocks between "Tree=i" markers
+    body = s.split("tree_sizes=")[1].split("\n", 1)[1]
+    blocks = []
+    cur = []
+    for line in body.splitlines(keepends=True):
+        if line.startswith("Tree=") and cur:
+            blocks.append("".join(cur))
+            cur = [line]
+        elif line.strip() == "end of trees":
+            blocks.append("".join(cur))
+            break
+        elif line.startswith("Tree=") or cur:
+            cur.append(line)
+    # strip the leading blank line that separates header from first tree
+    blocks = [b.lstrip("\n") for b in blocks if b.strip()]
+    assert len(blocks) == 3
+    for size, block in zip(sizes, blocks):
+        assert size == len(block.encode())
+
+
+def test_multiclass_roundtrip():
+    X, y = make_multiclass(900, k=3)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "num_leaves": 7, "verbosity": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=5)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(loaded.predict(X), bst.predict(X), rtol=1e-9)
+    assert loaded.num_trees() == 15
+
+
+def test_regression_roundtrip_with_nan():
+    X, y = make_regression(600)
+    X = X.copy()
+    X[::7, 0] = np.nan
+    bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=8)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    np.testing.assert_allclose(loaded.predict(X), bst.predict(X), rtol=1e-9)
+
+
+def test_dump_model_json():
+    bst, X, y = _train_binary(rounds=2)
+    d = bst.dump_model()
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 2
+    node = d["tree_info"][0]["tree_structure"]
+    assert "split_feature" in node
+    # walk to a leaf
+    while "leaf_value" not in node:
+        node = node["left_child"]
+    assert isinstance(node["leaf_value"], float)
+
+
+def test_loaded_model_metadata():
+    bst, X, y = _train_binary(rounds=4)
+    m = load_model_from_string(bst.model_to_string())
+    assert m.num_iterations == 4
+    assert m.feature_names == [f"Column_{i}" for i in range(8)]
+    assert m.objective_str.startswith("binary")
+    assert m.params.get("num_leaves") == "15"
+
+
+def test_first_tree_contains_init_bias():
+    bst, X, y = _train_binary(rounds=1)
+    raw = bst.predict(X, raw_score=True)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    raw2 = loaded.predict(X, raw_score=True)
+    np.testing.assert_allclose(raw, raw2, rtol=1e-9)
+    prior = np.log(y.mean() / (1 - y.mean()))
+    assert abs(raw.mean() - prior) < 1.0
+
+
+def test_shap_sums_to_prediction():
+    bst, X, y = _train_binary(rounds=3)
+    contrib = bst.predict(X[:20], pred_contrib=True)
+    raw = bst.predict(X[:20], raw_score=True)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_refit():
+    bst, X, y = _train_binary(rounds=5)
+    rng = np.random.RandomState(9)
+    X2, y2 = make_binary(400, seed=123)
+    new_bst = bst.refit(X2, y2, decay_rate=0.5)
+    p = new_bst.predict(X2)
+    assert p.shape == (400,)
+    # refit model differs from original but still predicts sensibly
+    assert not np.allclose(new_bst.predict(X), bst.predict(X))

@@ -1,0 +1,182 @@
+"""Objective gradient/hessian correctness (vs finite differences of the
+corresponding losses) — the strategy the reference validates through
+training behavior in test_engine.py; here we check the math directly."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.dataset import Metadata
+from lightgbm_tpu.objectives import create_objective
+
+
+def _make(obj_name, label, weight=None, group=None, **params):
+    cfg = Config.from_params({"objective": obj_name, **params})
+    obj = create_objective(cfg)
+    meta = Metadata(len(label))
+    meta.set_label(np.asarray(label, np.float32))
+    if weight is not None:
+        meta.set_weight(weight)
+    if group is not None:
+        meta.set_group(group)
+    obj.init(meta, len(label))
+    return obj
+
+
+def _fd_check(obj, loss_fn, score, rtol=1e-2, atol=1e-3):
+    """Finite-difference check grad of sum(loss) wrt score."""
+    g, h = obj.get_gradients(jnp.asarray(score, jnp.float32))
+    g = np.asarray(g)
+    eps = 1e-3
+    for i in range(0, len(score), max(len(score) // 7, 1)):
+        sp = score.copy()
+        sp[i] += eps
+        sm = score.copy()
+        sm[i] -= eps
+        fd = (loss_fn(sp) - loss_fn(sm)) / (2 * eps)
+        assert g[i] == pytest.approx(fd, rel=rtol, abs=atol), f"idx {i}"
+
+
+def test_l2_gradients():
+    rng = np.random.RandomState(0)
+    y = rng.randn(50)
+    s = rng.randn(50)
+    obj = _make("regression", y)
+    # LightGBM convention: grad = score - label, hess = 1
+    g, h = obj.get_gradients(jnp.asarray(s, jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), s - y, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), 1.0)
+
+
+def test_l1_gradients():
+    y = np.array([1.0, 2.0, 3.0])
+    s = np.array([2.0, 1.0, 3.5])
+    obj = _make("regression_l1", y)
+    g, _ = obj.get_gradients(jnp.asarray(s, jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), [1.0, -1.0, 1.0])
+
+
+def test_binary_gradients_fd():
+    rng = np.random.RandomState(1)
+    y01 = (rng.rand(40) > 0.5).astype(np.float64)
+    s = rng.randn(40)
+    obj = _make("binary", y01)
+
+    def loss(sc):
+        p = 1 / (1 + np.exp(-sc))
+        return np.sum(-(y01 * np.log(p) + (1 - y01) * np.log(1 - p)))
+    _fd_check(obj, loss, s)
+
+
+def test_binary_boost_from_score():
+    y = np.array([1, 1, 1, 0], np.float64)
+    obj = _make("binary", y)
+    init = obj.boost_from_score()
+    assert 1 / (1 + np.exp(-init)) == pytest.approx(0.75, abs=1e-6)
+
+
+def test_poisson_gradients_fd():
+    rng = np.random.RandomState(2)
+    y = rng.poisson(3.0, 30).astype(np.float64)
+    s = rng.randn(30) * 0.5
+    obj = _make("poisson", y)
+
+    def loss(sc):
+        return np.sum(np.exp(sc) - y * sc)
+    g, _ = obj.get_gradients(jnp.asarray(s, jnp.float32))
+    eps = 1e-4
+    for i in range(0, 30, 5):
+        sp, sm = s.copy(), s.copy()
+        sp[i] += eps
+        sm[i] -= eps
+        fd = (loss(sp) - loss(sm)) / (2 * eps)
+        assert np.asarray(g)[i] == pytest.approx(fd, rel=1e-2)
+
+
+def test_quantile_gradients():
+    y = np.array([0.0, 10.0])
+    s = np.array([5.0, 5.0])
+    obj = _make("quantile", y, alpha=0.9)
+    g, _ = obj.get_gradients(jnp.asarray(s, jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), [0.1, -0.9], atol=1e-6)
+
+
+def test_tweedie_gradients_fd():
+    rng = np.random.RandomState(3)
+    y = np.abs(rng.randn(30)) * 2
+    s = rng.randn(30) * 0.3
+    rho = 1.5
+    obj = _make("tweedie", y)
+
+    def loss(sc):
+        return np.sum(-y * np.exp((1 - rho) * sc) / (1 - rho)
+                      + np.exp((2 - rho) * sc) / (2 - rho))
+    _fd_check(obj, loss, s, rtol=2e-2)
+
+
+def test_multiclass_softmax_gradients():
+    rng = np.random.RandomState(4)
+    n, k = 30, 4
+    y = rng.randint(0, k, n).astype(np.float64)
+    scores = rng.randn(k, n)
+    obj = _make("multiclass", y, num_class=k)
+    g, h = obj.get_gradients_multi(jnp.asarray(scores, jnp.float32))
+    e = np.exp(scores - scores.max(0, keepdims=True))
+    p = e / e.sum(0, keepdims=True)
+    onehot = (y[None, :] == np.arange(k)[:, None])
+    np.testing.assert_allclose(np.asarray(g), p - onehot, rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(np.asarray(h), 2 * p * (1 - p), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_weighted_gradients():
+    y = np.array([1.0, 2.0])
+    w = np.array([2.0, 0.5])
+    s = np.array([0.0, 0.0])
+    obj = _make("regression", y, weight=w)
+    g, h = obj.get_gradients(jnp.asarray(s, jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), (s - y) * w, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(h), w, rtol=1e-6)
+
+
+def test_lambdarank_gradients_direction():
+    # 2 queries; within each, doc with higher label should get negative
+    # gradient (pushed up) when scores are flat
+    y = np.array([0, 2, 0, 1], np.float64)
+    group = np.array([2, 2])
+    obj = _make("lambdarank", y, group=group)
+    s = np.zeros(4, np.float32)
+    g, h = obj.get_gradients(jnp.asarray(s))
+    g = np.asarray(g)
+    assert g[1] < 0 < g[0]
+    assert g[3] < 0 < g[2]
+    assert np.all(np.asarray(h) >= 0)
+
+
+def test_rank_xendcg_gradients_sum_zero_per_query():
+    y = np.array([0, 1, 2, 0, 3, 1], np.float64)
+    group = np.array([3, 3])
+    obj = _make("rank_xendcg", y, group=group)
+    s = np.random.RandomState(5).randn(6).astype(np.float32)
+    g, h = obj.get_gradients(jnp.asarray(s))
+    g = np.asarray(g)
+    assert abs(g[:3].sum()) < 1e-5
+    assert abs(g[3:].sum()) < 1e-5
+    # higher label, equal score -> more negative gradient
+    assert g[4] == np.min(g[3:])
+
+
+def test_renew_tree_output_l1():
+    """L1 leaf values become medians of residuals (ref: RenewTreeOutput)."""
+    from lightgbm_tpu.tree import Tree
+    y = np.array([0.0, 1.0, 2.0, 10.0, 11.0, 12.0])
+    obj = _make("regression_l1", y)
+    tree = Tree(2)
+    tree.leaf_value = np.array([99.0, 98.0])
+    row_leaf = np.array([0, 0, 0, 1, 1, 1])
+    renewed = obj.renew_tree_output(tree, np.zeros(6, np.float32), row_leaf,
+                                    np.ones(6, np.float32))
+    assert renewed.leaf_value[0] == pytest.approx(1.0)
+    assert renewed.leaf_value[1] == pytest.approx(11.0)
