@@ -1,0 +1,83 @@
+"""Benchmark: boosting iterations/sec on Higgs-shaped data.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference CPU result on Higgs-10.5M — 500 iterations in
+130.094 s => 3.843 iters/sec (docs/Experiments.rst:113; see BASELINE.md).
+Config mirrors the reference GPU benchmark setup (max_bin=63,
+num_leaves=255, lr=0.1, min_sum_hessian=100, objective=binary —
+docs/GPU-Performance.rst:108-123).
+
+The dataset is synthetic with Higgs shape (28 features, N rows; the real
+Higgs is not redistributable and this environment has no egress). Row
+count defaults to 10.5M (override with BENCH_ROWS) so iters/sec is
+directly comparable to the published 3.843.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    n = int(os.environ.get("BENCH_ROWS", 10_500_000))
+    f = 28
+    iters = int(os.environ.get("BENCH_ITERS", 10))
+    warmup = 2
+
+    import jax
+    import lightgbm_tpu as lgb
+
+    rng = np.random.RandomState(0)
+    # Higgs-like: mix of informative and noise features, ~53% positive
+    x = rng.randn(n, f).astype(np.float32)
+    logit = (x[:, 0] + 0.6 * x[:, 1] ** 2 + 0.4 * x[:, 2] * x[:, 3]
+             - 0.3 * np.abs(x[:, 4]) + 0.5 * rng.randn(n))
+    y = (logit > 0.2).astype(np.float32)
+
+    params = {
+        "objective": "binary",
+        "num_leaves": 255,
+        "learning_rate": 0.1,
+        "max_bin": 63,
+        "min_sum_hessian_in_leaf": 100,
+        "min_data_in_leaf": 0,
+        "verbosity": -1,
+    }
+    t0 = time.time()
+    ds = lgb.Dataset(x, label=y, params=params)
+    ds.construct()
+    bin_time = time.time() - t0
+
+    bst = lgb.Booster(params, ds)
+    t0 = time.time()
+    for _ in range(warmup):
+        bst.update()
+    jax.block_until_ready(bst._gbdt.scores)
+    warm_time = time.time() - t0
+
+    t0 = time.time()
+    for _ in range(iters):
+        bst.update()
+    jax.block_until_ready(bst._gbdt.scores)
+    dt = (time.time() - t0) / iters
+
+    iters_per_sec = 1.0 / dt
+    baseline = 500.0 / 130.094  # reference CPU Higgs iters/sec
+    result = {
+        "metric": "boosting_iters_per_sec_higgs_shape",
+        "value": round(iters_per_sec, 4),
+        "unit": "iters/sec (N=%d, 255 leaves, 63 bins)" % n,
+        "vs_baseline": round(iters_per_sec / baseline, 4),
+    }
+    print(json.dumps(result))
+    print(f"# bin={bin_time:.1f}s warmup+compile={warm_time:.1f}s "
+          f"per_iter={dt:.3f}s", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

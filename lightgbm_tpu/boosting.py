@@ -26,8 +26,9 @@ import jax.numpy as jnp
 
 from .config import Config
 from .dataset import BinnedDataset
-from .learner import grow_tree
+from .learner import grow_tree, replay_tree
 from .objectives import ObjectiveFunction, create_objective
+from .ops import histogram as hist_ops
 from .ops.split import FeatureMeta, SplitHyperParams
 from .tree import Tree
 
@@ -55,7 +56,10 @@ class GBDT:
             else self.num_class)
         self.shrinkage_rate = config.learning_rate
         self.iter = 0
-        self.models: List[List[Tree]] = []  # [iteration][class]
+        # host trees (materialized lazily from device records on the fast
+        # path; populated directly on the slow path)
+        self._host_models: List[List[Tree]] = []
+        self._device_records: List = []  # per fast-path iter: TreeArrays [K,...]
         self.init_scores = [0.0] * self.num_tree_per_iteration
         self._init_done = False
 
@@ -117,12 +121,190 @@ class GBDT:
         self._grad_scale = None  # GOSS amplification, set per iter
 
         # grown-tree jit (shared across iterations; one XLA program per tree)
-        self._grow = jax.jit(functools.partial(
-            grow_tree, **self._static, hist_dtype=jnp.float32))
+        self._build_grow(hist_ops.default_impl())
         self._update_score = jax.jit(
             lambda score, leaf_vals, row_leaf: score + leaf_vals[row_leaf])
         self._valid_sets: List = []
         self._valid_scores: List[np.ndarray] = []
+
+    def _build_grow(self, hist_impl: str) -> None:
+        self._hist_impl = hist_impl
+        self._grow = jax.jit(functools.partial(
+            grow_tree, **self._static, hist_dtype=jnp.float32,
+            hist_impl=hist_impl))
+        self._fused = None
+        self._record_lrs: List[float] = []
+        self._valid_bins: List = []  # device bins per valid set (fast path)
+
+    # ------------------------------------------------------------------
+    # fast path: one fused XLA program per iteration, zero host round-trips
+    # (the TPU analog of boosting_on_gpu_, gbdt.cpp:111 — and beyond: the
+    # CUDA learner still syncs once per split, this path not at all)
+    @property
+    def models(self) -> List[List[Tree]]:
+        self._materialize_records()
+        return self._host_models
+
+    @models.setter
+    def models(self, value) -> None:
+        self._host_models = value
+
+    def _fast_path_ok(self, custom_grad) -> bool:
+        if custom_grad is not None or self.objective is None:
+            return False
+        if self.boosting_type != "gbdt":
+            return False
+        # objectives that renew leaf outputs need per-iteration host work
+        renews = type(self.objective).renew_tree_output is not \
+            ObjectiveFunction.renew_tree_output
+        return not renews
+
+    def _grad_fn(self, scores):
+        """Traced gradient computation [K, N] (ref: GBDT::Boosting)."""
+        obj = self.objective
+        if hasattr(obj, "get_gradients_multi"):
+            return obj.get_gradients_multi(scores)
+        g, h = obj.get_gradients(scores[0])
+        return g[None, :], h[None, :]
+
+    def _sampling_in_jit(self, key, it, prev_mask):
+        """Bagging mask (traced; ref: bagging.hpp Bagging)."""
+        cfg = self.config
+        use_bagging = cfg.bagging_freq > 0 and (
+            cfg.bagging_fraction < 1.0 or cfg.pos_bagging_fraction < 1.0
+            or cfg.neg_bagging_fraction < 1.0)
+        if not use_bagging:
+            return prev_mask
+        u = jax.random.uniform(key, (self.num_data,))
+        pos_neg = (cfg.pos_bagging_fraction < 1.0 or
+                   cfg.neg_bagging_fraction < 1.0) and \
+            self.objective is not None and self.objective.name == "binary"
+        if pos_neg:
+            is_pos = self.objective.label > 0
+            frac = jnp.where(is_pos, cfg.pos_bagging_fraction,
+                             cfg.neg_bagging_fraction)
+        else:
+            frac = cfg.bagging_fraction
+        fresh = (u < frac).astype(jnp.float32)
+        resample = (it % cfg.bagging_freq) == 0
+        return jnp.where(resample, fresh, prev_mask)
+
+    def _goss_in_jit(self, key, grad, hess):
+        """(ref: goss.hpp:60-131)"""
+        cfg = self.config
+        n = self.num_data
+        top_k = max(1, int(n * cfg.top_rate))
+        other_k = max(1, int(n * cfg.other_rate))
+        score = jnp.abs(grad) * jnp.abs(hess)
+        thr = -jnp.sort(-score)[top_k - 1]
+        is_top = score >= thr
+        u = jax.random.uniform(key, (n,))
+        keep_rest_p = other_k / max(n - top_k, 1)
+        is_other = (~is_top) & (u < keep_rest_p)
+        amplify = (1.0 - cfg.top_rate) / cfg.other_rate
+        mask = (is_top | is_other).astype(jnp.float32)
+        scale = jnp.where(is_other, amplify, 1.0)
+        return mask, scale
+
+    def _feature_mask_in_jit(self, key):
+        cfg = self.config
+        f = self.train_set.num_features
+        if cfg.feature_fraction >= 1.0:
+            return jnp.ones(f, bool)
+        k = max(1, int(f * cfg.feature_fraction))
+        u = jax.random.uniform(key, (f,))
+        thr = jnp.sort(u)[k - 1]
+        return u <= thr
+
+    def _make_fused(self):
+        num_valid = len(self._valid_bins)
+        grow = functools.partial(grow_tree, **self._static,
+                                 hist_dtype=jnp.float32,
+                                 hist_impl=self._hist_impl)
+        goss = self.config.data_sample_strategy == "goss"
+
+        def fused(scores, sample_mask, valid_scores, it, lr):
+            key = jax.random.fold_in(self._bagging_key, it)
+            sample_mask = self._sampling_in_jit(
+                jax.random.fold_in(key, 1), it, sample_mask)
+            grad_all, hess_all = self._grad_fn(scores)
+            recs = []
+            new_valid = list(valid_scores)
+            for k in range(self.num_tree_per_iteration):
+                grad, hess = grad_all[k], hess_all[k]
+                mask = sample_mask
+                if goss:
+                    mask, scale = self._goss_in_jit(
+                        jax.random.fold_in(key, 100 + k), grad, hess)
+                    grad, hess = grad * scale, hess * scale
+                fmask = self._feature_mask_in_jit(
+                    jax.random.fold_in(key, 200 + k))
+                rec, row_leaf = grow(self.bins_fm, grad, hess, mask, fmask,
+                                     self.feature_meta, self.hp,
+                                     self.max_depth)
+                # 1-leaf trees contribute nothing (the reference stops
+                # training instead, gbdt.cpp should_continue)
+                leaf_vals = jnp.where(rec.num_leaves > 1,
+                                      rec.leaf_value * lr, 0.0)
+                scores = scores.at[k].add(leaf_vals[row_leaf])
+                for vi in range(num_valid):
+                    vleaf = replay_tree(rec, self._valid_bins[vi],
+                                        self.feature_meta)
+                    new_valid[vi] = new_valid[vi].at[k].add(leaf_vals[vleaf])
+                recs.append(rec)
+            if len(recs) == 1:
+                stacked = jax.tree_util.tree_map(lambda x: x[None], recs[0])
+            else:
+                stacked = jax.tree_util.tree_map(
+                    lambda *xs: jnp.stack(xs), *recs)
+            return scores, sample_mask, tuple(new_valid), stacked
+
+        return jax.jit(fused, donate_argnums=(0, 1, 2))
+
+    def _train_one_iter_fast(self) -> bool:
+        self._boost_from_average()
+        if self._fused is None:
+            self._fused = self._make_fused()
+        self.scores, self._sample_mask, valid, recs = self._fused(
+            self.scores, self._sample_mask, tuple(self._valid_scores),
+            jnp.int32(self.iter), jnp.float32(self.shrinkage_rate))
+        self._valid_scores = list(valid)
+        self._device_records.append(recs)
+        self._record_lrs.append(self.shrinkage_rate)
+        self.iter += 1
+        return False
+
+    def _materialize_records(self) -> None:
+        if not self._device_records:
+            return
+        recs, lrs = self._device_records, self._record_lrs
+        self._device_records, self._record_lrs = [], []
+        if len(recs) == 1:
+            stacked = recs[0]
+            host = jax.device_get(stacked)
+            host = jax.tree_util.tree_map(lambda x: x[None], host)
+        else:
+            stacked = jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *recs)
+            host = jax.device_get(stacked)  # ONE device->host transfer set
+        k_per = self.num_tree_per_iteration
+        for i in range(len(recs)):
+            first_iter = len(self._host_models) == 0
+            iter_trees = []
+            for k in range(k_per):
+                rec = {f: np.asarray(getattr(host, f)[i][k])
+                       for f in host._fields}
+                tree = Tree.from_arrays(rec, self.train_set.mappers,
+                                        self.train_set.used_features)
+                if tree.num_leaves > 1:
+                    tree.apply_shrinkage(lrs[i])
+                    if first_iter and abs(self.init_scores[k]) > K_EPSILON:
+                        tree.add_bias(self.init_scores[k])
+                else:
+                    tree.leaf_value[:] = (self.init_scores[k]
+                                          if first_iter else 0.0)
+                iter_trees.append(tree)
+            self._host_models.append(iter_trees)
 
     # ------------------------------------------------------------------
     # bagging / GOSS (ref: bagging.hpp:15, goss.hpp:19)
@@ -198,8 +380,7 @@ class GBDT:
                                [:, None])
             self.scores = self.scores + init
             for vi in range(len(self._valid_scores)):
-                self._valid_scores[vi] = self._valid_scores[vi] + \
-                    np.asarray(self.init_scores)[None, :]
+                self._valid_scores[vi] = self._valid_scores[vi] + init
 
     def _gradients(self, custom_grad=None, custom_hess=None):
         """-> grad, hess [K, N] (ref: GBDT::Boosting gbdt.cpp:229)."""
@@ -219,6 +400,8 @@ class GBDT:
     def train_one_iter(self, custom_grad=None, custom_hess=None) -> bool:
         """Returns True when training should stop (no splittable leaves),
         matching the reference return convention (gbdt.cpp:353)."""
+        if self._fast_path_ok(custom_grad):
+            return self._train_one_iter_fast()
         if custom_grad is None:
             self._boost_from_average()
         grad_all, hess_all = self._gradients(custom_grad, custom_hess)
@@ -278,29 +461,32 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def add_valid(self, valid_set, raw_data: Optional[np.ndarray]) -> None:
-        """Register a validation set; scores updated incrementally
-        (ref: GBDT::AddValidDataset gbdt.cpp)."""
+        """Register a validation set; scores held on device [K, Nv] and
+        updated incrementally (ref: GBDT::AddValidDataset gbdt.cpp)."""
         self._valid_sets.append((valid_set, raw_data))
         n = valid_set.num_data
-        score = np.zeros((n, self.num_tree_per_iteration))
+        score = np.zeros((self.num_tree_per_iteration, n), np.float32)
         # catch up on existing model
-        if self.models:
+        if self.current_iteration() > 0:
             raw = self.predict_raw(raw_data)
-            score = raw.reshape(n, self.num_tree_per_iteration)
+            score = raw.reshape(n, self.num_tree_per_iteration).T
         elif any(abs(s) > K_EPSILON for s in self.init_scores):
-            score += np.asarray(self.init_scores)[None, :]
+            score += np.asarray(self.init_scores, np.float32)[:, None]
         if valid_set.metadata.init_score is not None:
             init = np.asarray(valid_set.metadata.init_score, np.float64)
-            score += init.reshape(n, -1, order="F") \
-                if init.size != n else init.reshape(n, 1)
-        self._valid_scores.append(score)
+            score += (init.reshape(-1, n) if init.size != n
+                      else init.reshape(1, n)).astype(np.float32)
+        self._valid_scores.append(jnp.asarray(score))
+        self._valid_bins.append(valid_set.device_bins())
+        self._fused = None  # fused program must include the new valid set
 
     def _update_valid_scores(self, tree: Tree, class_id: int) -> None:
-        for (vs, raw), score in zip(self._valid_sets, self._valid_scores):
-            score[:, class_id] += tree.predict(raw)
+        for i, (vs, raw) in enumerate(self._valid_sets):
+            self._valid_scores[i] = self._valid_scores[i].at[class_id].add(
+                jnp.asarray(tree.predict(raw).astype(np.float32)))
 
     def valid_raw_scores(self, idx: int) -> np.ndarray:
-        return self._valid_scores[idx]
+        return np.asarray(self._valid_scores[idx]).T
 
     # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
@@ -309,16 +495,15 @@ class GBDT:
             return
         trees = self.models.pop()
         for k, tree in enumerate(trees):
-            delta = jnp.asarray((-tree.leaf_value).astype(np.float32))
             if tree.num_leaves > 1:
                 # recompute leaf assignment for train rows via binned predict
                 leaves = self._predict_leaf_binned_train(tree)
                 self.scores = self.scores.at[k].add(
                     jnp.asarray((-tree.leaf_value.astype(np.float32)))[leaves])
-            del delta
-        for (vs, raw), score in zip(self._valid_sets, self._valid_scores):
+        for i, (vs, raw) in enumerate(self._valid_sets):
             for k, tree in enumerate(trees):
-                score[:, k] -= tree.predict(raw)
+                self._valid_scores[i] = self._valid_scores[i].at[k].add(
+                    jnp.asarray(-tree.predict(raw).astype(np.float32)))
         self.iter -= 1
 
     def _predict_leaf_binned_train(self, tree: Tree):
@@ -425,10 +610,10 @@ class GBDT:
 
     @property
     def num_trees(self) -> int:
-        return sum(len(it) for it in self.models)
+        return self.current_iteration() * self.num_tree_per_iteration
 
     def current_iteration(self) -> int:
-        return len(self.models)
+        return len(self._host_models) + len(self._device_records)
 
 
 class DART(GBDT):
@@ -448,30 +633,24 @@ class DART(GBDT):
         drop_idx = self._select_drop(len(self.models))
         # subtract dropped trees from scores (dart.hpp DroppingTrees)
         for di in drop_idx:
-            for k, tree in enumerate(self.models[di]):
-                leaves = self._predict_leaf_binned_train(tree)
-                self.scores = self.scores.at[k].add(jnp.asarray(
-                    (-tree.leaf_value).astype(np.float32))[leaves])
-            for (vs, raw), score in zip(self._valid_sets, self._valid_scores):
-                for k, tree in enumerate(self.models[di]):
-                    score[:, k] -= tree.predict(raw)
+            self._add_tree_scores(self.models[di], sign=-1.0)
 
         stop = super().train_one_iter(custom_grad, custom_hess)
-        if stop:
-            # restore dropped trees
-            drop_idx_restore = drop_idx
-        else:
+        if not stop:
             self._normalize(drop_idx)
-            drop_idx_restore = drop_idx
-        for di in drop_idx_restore:
-            for k, tree in enumerate(self.models[di]):
-                leaves = self._predict_leaf_binned_train(tree)
-                self.scores = self.scores.at[k].add(jnp.asarray(
-                    tree.leaf_value.astype(np.float32))[leaves])
-            for (vs, raw), score in zip(self._valid_sets, self._valid_scores):
-                for k, tree in enumerate(self.models[di]):
-                    score[:, k] += tree.predict(raw)
+        for di in drop_idx:
+            self._add_tree_scores(self.models[di], sign=1.0)
         return stop
+
+    def _add_tree_scores(self, trees, sign: float) -> None:
+        for k, tree in enumerate(trees):
+            leaves = self._predict_leaf_binned_train(tree)
+            self.scores = self.scores.at[k].add(jnp.asarray(
+                (sign * tree.leaf_value).astype(np.float32))[leaves])
+        for i, (vs, raw) in enumerate(self._valid_sets):
+            for k, tree in enumerate(trees):
+                self._valid_scores[i] = self._valid_scores[i].at[k].add(
+                    jnp.asarray(sign * tree.predict(raw).astype(np.float32)))
 
     def _select_drop(self, n_models: int) -> List[int]:
         cfg = self.config
@@ -509,8 +688,10 @@ class DART(GBDT):
             leaves = self._predict_leaf_binned_train(tree)
             self.scores = self.scores.at[k].add(jnp.asarray(
                 (tree.leaf_value * delta).astype(np.float32))[leaves])
-            for (vs, raw), score in zip(self._valid_sets, self._valid_scores):
-                score[:, k] += tree.predict(raw) * delta
+            for i, (vs, raw) in enumerate(self._valid_sets):
+                self._valid_scores[i] = self._valid_scores[i].at[k].add(
+                    jnp.asarray((tree.predict(raw) * delta)
+                                .astype(np.float32)))
             tree.apply_shrinkage(new_factor)
         # scale the dropped trees
         for di in drop_idx:

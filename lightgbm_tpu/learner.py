@@ -108,7 +108,8 @@ def grow_tree(bins_fm: jax.Array,
               num_leaves: int,
               max_bins: int,
               hist_dtype=jnp.float32,
-              row_chunk: int = 0):
+              row_chunk: int = 0,
+              hist_impl: str = "xla"):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
 
     sample_mask: [N] float {0,1} bagging/GOSS selection (excluded rows still
@@ -121,7 +122,7 @@ def grow_tree(bins_fm: jax.Array,
     f32 = hist_dtype
 
     build = functools.partial(hist_ops.build_histogram, max_bins=max_bins,
-                              dtype=f32, row_chunk=row_chunk)
+                              dtype=f32, row_chunk=row_chunk, impl=hist_impl)
 
     # --- root (ref: serial_tree_learner.cpp BeforeTrain root LeafSplits init)
     root_hist = build(bins_fm, grad, hess, sample_mask)
@@ -226,13 +227,16 @@ def grow_tree(bins_fm: jax.Array,
                                          0.0)
         return _GrowState(row_leaf, pool, leaves), record
 
-    state, records = lax.scan(step, state, jnp.arange(L - 1, dtype=jnp.int32))
+    # unroll=2: a single-step scan body wrapping pallas_call lowers to a
+    # pathologically slow while-loop on TPU (~1000x); any unrolling avoids it
+    state, records = lax.scan(step, state, jnp.arange(L - 1, dtype=jnp.int32),
+                              unroll=2 if L > 2 else 1)
 
     leaves = state.leaves
     leaf_values = leaf_output(leaves.sum_grad, leaves.sum_hess, hp)
     num_leaves_out = 1 + jnp.sum(records["split_leaf"] >= 0).astype(jnp.int32)
 
-    tree = TreeArrays(
+    tree_arrays = TreeArrays(
         split_leaf=records["split_leaf"],
         split_feature=records["split_feature"],
         split_bin_threshold=records["split_bin_threshold"],
@@ -246,4 +250,29 @@ def grow_tree(bins_fm: jax.Array,
         leaf_count=leaves.count,
         num_leaves=num_leaves_out,
     )
-    return tree, state.row_leaf
+    return tree_arrays, state.row_leaf
+
+
+def replay_tree(tree: TreeArrays, bins_fm: jax.Array,
+                meta: FeatureMeta) -> jax.Array:
+    """Re-derive the row -> leaf map of a grown tree on another binned
+    dataset (device). Replays the recorded splits in creation order — the
+    device analog of updating a validation ScoreUpdater
+    (ref: score_updater.hpp:22, gbdt.cpp UpdateScore valid path)."""
+    num_data = bins_fm.shape[1]
+    num_splits = tree.split_leaf.shape[0]
+
+    def step(row_leaf, inputs):
+        step_idx, leaf, feat, thr, dleft = inputs
+        row_leaf = part_ops.apply_split(
+            row_leaf, bins_fm, leaf, step_idx + 1, feat, thr, dleft,
+            meta.num_bins, meta.missing_type, meta.is_categorical, leaf >= 0)
+        return row_leaf, None
+
+    row_leaf, _ = lax.scan(
+        step, jnp.zeros(num_data, jnp.int32),
+        (jnp.arange(num_splits, dtype=jnp.int32), tree.split_leaf,
+         tree.split_feature, tree.split_bin_threshold,
+         tree.split_default_left),
+        unroll=2 if num_splits > 1 else 1)
+    return row_leaf

@@ -39,10 +39,20 @@ def _hist_all_features(bins_fm: jax.Array, gh: jax.Array, max_bins: int,
     return hist
 
 
-@functools.partial(jax.jit, static_argnames=("max_bins", "dtype", "row_chunk"))
+def default_impl() -> str:
+    """'pallas' on TPU backends, 'xla' elsewhere (CPU tests, interpret)."""
+    try:
+        return "xla" if jax.default_backend() == "cpu" else "pallas"
+    except Exception:
+        return "xla"
+
+
+@functools.partial(jax.jit, static_argnames=("max_bins", "dtype", "row_chunk",
+                                             "impl"))
 def build_histogram(bins_fm: jax.Array, grad: jax.Array, hess: jax.Array,
                     mask: jax.Array, *, max_bins: int,
-                    dtype=jnp.float32, row_chunk: int = 0) -> jax.Array:
+                    dtype=jnp.float32, row_chunk: int = 0,
+                    impl: str = "xla") -> jax.Array:
     """Build per-feature (grad, hess, count) histograms for one leaf.
 
     Args:
@@ -57,6 +67,11 @@ def build_histogram(bins_fm: jax.Array, grad: jax.Array, hess: jax.Array,
     Returns:
       ``[F, B, 3]`` histogram in `dtype`.
     """
+    if impl == "pallas":
+        from .pallas_histogram import hist_pallas
+        gh3 = jnp.stack([grad * mask, hess * mask, mask]).astype(jnp.float32)
+        return hist_pallas(bins_fm, gh3, max_bins=max_bins).astype(dtype)
+
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1).astype(dtype)  # [N, 3]
     num_features = bins_fm.shape[0]
     n = gh.shape[0]

@@ -1,0 +1,93 @@
+"""Pallas TPU histogram kernel.
+
+The performance-critical op (ref: the CUDA shared-memory histogram kernels,
+src/treelearner/cuda/cuda_histogram_constructor.cu:21). The XLA one-hot
+formulation materializes the [N, B] one-hot in HBM (~B x 4 bytes per
+element); this kernel builds one-hot tiles in VMEM only, so HBM traffic
+drops to one read of the bin matrix (1 byte/element) plus the gh vectors —
+the bandwidth floor.
+
+Layout: bins [F, N] (feature-major), gh [3, N] (grad, hess, count rows,
+pre-masked), output hist [F, 3, B].
+
+Grid: (feature_blocks, row_chunks); row chunks accumulate into the same
+output block (TPU grids execute sequentially, minor-dim fastest).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _hist_kernel(bins_ref, gh_ref, out_ref, *, f_blk: int, max_bins: int,
+                 precise: bool):
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    gh = gh_ref[...]  # [3, C] f32
+    chunk = gh.shape[1]
+    prec = lax.Precision.HIGHEST if precise else lax.Precision.DEFAULT
+
+    # static unroll: dynamic sublane indexing into a uint8 tile is not
+    # supported by Mosaic; keep f_blk * chunk * B * 4 bytes under VMEM
+    for f in range(f_blk):
+        b = bins_ref[f, :].astype(jnp.int32)  # [C]
+        onehot = (b[:, None] == lax.broadcasted_iota(
+            jnp.int32, (chunk, max_bins), 1)).astype(jnp.float32)
+        out_ref[f, :, :] += jax.lax.dot(gh, onehot, precision=prec)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_bins", "f_blk", "row_chunk",
+                                    "precise", "interpret"))
+def hist_pallas(bins_fm: jax.Array, gh3: jax.Array, *, max_bins: int,
+                f_blk: int = 8, row_chunk: int = 0,
+                precise: bool = True, interpret: bool = False) -> jax.Array:
+    """bins_fm [F, N] uint8/uint16, gh3 [3, N] f32 (pre-masked) ->
+    hist [F, B, 3] f32."""
+    num_features, n = bins_fm.shape
+    if row_chunk == 0:
+        # keep the f_blk unrolled one-hot buffers under ~8 MB of VMEM
+        budget = 8 * 1024 * 1024 // (f_blk * max_bins * 4)
+        row_chunk = max(512, min(2048, (budget // 512) * 512))
+    # pad N to a multiple of row_chunk (pad bins with max_bins -> one-hot
+    # of the padded rows is all-zero, and gh pads with zeros anyway)
+    pad_n = (-n) % row_chunk
+    if pad_n:
+        bins_fm = jnp.pad(bins_fm, ((0, 0), (0, pad_n)),
+                          constant_values=max_bins)
+        gh3 = jnp.pad(gh3, ((0, 0), (0, pad_n)))
+    pad_f = (-num_features) % f_blk
+    if pad_f:
+        bins_fm = jnp.pad(bins_fm, ((0, pad_f), (0, 0)),
+                          constant_values=max_bins)
+    fp = bins_fm.shape[0]
+    npad = bins_fm.shape[1]
+
+    grid = (fp // f_blk, npad // row_chunk)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, f_blk=f_blk, max_bins=max_bins,
+                          precise=precise),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((f_blk, row_chunk), lambda j, i: (j, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((3, row_chunk), lambda j, i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((f_blk, 3, max_bins), lambda j, i: (j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((fp, 3, max_bins), jnp.float32),
+        interpret=interpret,
+    )(bins_fm, gh3)
+    # [F, 3, B] -> [F, B, 3] to match the XLA path's layout
+    return jnp.swapaxes(out[:num_features], 1, 2)

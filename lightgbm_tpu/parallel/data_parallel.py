@@ -47,6 +47,11 @@ class _DataParallelMixin:
                                                 row_axis=0)
         self.feature_meta = jax.tree_util.tree_map(
             lambda a: mesh_lib.replicate(self.mesh, a), self.feature_meta)
+        if self.mesh.size > 1:
+            # pallas_call does not auto-partition under GSPMD; the XLA
+            # one-hot path partitions its contraction over the sharded row
+            # axis (shard_map + pallas planned)
+            self._build_grow("xla")
 
     @property
     def num_machines(self) -> int:
