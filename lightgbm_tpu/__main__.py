@@ -1,0 +1,8 @@
+"""`python -m lightgbm_tpu key=value ...` — the CLI entry point
+(ref: src/main.cpp:16)."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

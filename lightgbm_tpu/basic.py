@@ -46,9 +46,34 @@ class Dataset:
                  params: Optional[Dict[str, Any]] = None,
                  free_raw_data: bool = False, position=None):
         if isinstance(data, (str, Path)):
+            path = str(data)
+            with open(path, "rb") as fh:
+                magic = fh.read(2)
+            loaded = None
+            if magic == b"PK":  # zip container: try the binary-dataset path
+                from .io.binary_format import load_dataset_binary
+                try:
+                    loaded = load_dataset_binary(path)
+                except Exception:
+                    loaded = None  # not ours — fall through to text parsing
+            if loaded is not None:
+                self.__dict__.update(loaded.__dict__)
+                # user-supplied metadata overrides the stored copy
+                for value, setter in ((label, self.set_label),
+                                      (weight, self.set_weight),
+                                      (group, self.set_group),
+                                      (init_score, self.set_init_score)):
+                    if value is not None:
+                        setter(value)
+                if params:
+                    import warnings
+                    warnings.warn(
+                        "dataset params are ignored when loading a binary "
+                        "dataset file (binning is already fixed)")
+                return
             from .io.text_loader import load_svmlight_or_csv
             data, file_label, file_weight, file_group = \
-                load_svmlight_or_csv(str(data), params or {})
+                load_svmlight_or_csv(path, params or {})
             if label is None:
                 label = file_label
             if weight is None:
@@ -354,8 +379,14 @@ class Booster:
         if data.ndim == 1:
             data = data.reshape(1, -1)
         if self._loaded is not None:
-            if pred_leaf or pred_contrib:
-                raise LightGBMError("pred_leaf/contrib need a trained booster")
+            if pred_contrib:
+                from .shap import loaded_pred_contrib
+                return loaded_pred_contrib(self._loaded, data,
+                                           start_iteration, num_iteration)
+            if pred_leaf:
+                return self._loaded.predict_leaf(
+                    data, start_iteration=start_iteration,
+                    num_iteration=num_iteration)
             return self._loaded.predict(data, raw_score=raw_score,
                                         start_iteration=start_iteration,
                                         num_iteration=num_iteration)
@@ -376,6 +407,10 @@ class Booster:
     def model_to_string(self, num_iteration: int = -1,
                         start_iteration: int = 0,
                         importance_type: str = "split") -> str:
+        if self._loaded is not None:
+            from .model_io import loaded_model_to_string
+            return loaded_model_to_string(self._loaded, num_iteration,
+                                          start_iteration)
         return save_model_to_string(self._gbdt, num_iteration,
                                     start_iteration, importance_type)
 

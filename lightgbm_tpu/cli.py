@@ -1,0 +1,229 @@
+"""Command-line application: train / predict / refit / convert_model /
+save_binary over `key=value` args and config files.
+
+TPU-native counterpart of the reference CLI (ref: src/main.cpp:16,
+src/application/application.cpp:35 Application, application.h task enum).
+Accepts the same `key=value` argument style, `config=<file>` config files
+(`key = value` lines, `#` comments), and runs against the same example
+configs (`examples/*/train.conf`). Command-line pairs override config-file
+pairs (ref: application.cpp:60-88 LoadParameters precedence).
+"""
+
+from __future__ import annotations
+
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .basic import Booster, Dataset, LightGBMError
+from .config import Config
+from .engine import train as train_fn
+from . import callback as callback_mod
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """`key = value` per line; `#` starts a comment
+    (ref: Config::KV2Map + application.cpp:53 LoadParameters)."""
+    out: Dict[str, str] = {}
+    with open(path) as fh:
+        for raw in fh:
+            line = raw.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            key, value = line.split("=", 1)
+            key, value = key.strip(), value.strip()
+            if key:
+                out[Config.canonical_key(key)] = value
+    return out
+
+
+def parse_cli_args(argv: List[str]) -> Dict[str, str]:
+    """key=value tokens; config= pulls in a file, CLI pairs win."""
+    cli_pairs: Dict[str, str] = {}
+    config_file: Optional[str] = None
+    for tok in argv:
+        if "=" not in tok:
+            raise LightGBMError(f"unknown argument (expected key=value): {tok}")
+        key, value = tok.split("=", 1)
+        key = Config.canonical_key(key.strip())
+        value = value.strip()
+        if key == "config":
+            config_file = value
+        else:
+            cli_pairs[key] = value
+    params: Dict[str, str] = {}
+    if config_file:
+        params.update(parse_config_file(config_file))
+    params.update(cli_pairs)
+    return params
+
+
+class Application:
+    """One CLI run (ref: src/application/application.cpp:35)."""
+
+    def __init__(self, argv: List[str]):
+        self.params = parse_cli_args(argv)
+        self.config = Config.from_params(self.params)
+
+    def run(self) -> None:
+        task = self.config.task
+        if task == "train":
+            self._train()
+        elif task in ("predict", "prediction", "test"):
+            self._predict()
+        elif task == "convert_model":
+            self._convert_model()
+        elif task in ("refit", "refit_tree"):
+            self._refit()
+        elif task == "save_binary":
+            self._save_binary()
+        else:
+            raise LightGBMError(f"unknown task: {task}")
+
+    # ------------------------------------------------------------------
+    def _load_train_data(self) -> Dataset:
+        if not self.config.data:
+            raise LightGBMError("no training data (`data=` missing)")
+        return Dataset(self.config.data, params=dict(self.params))
+
+    def _train(self) -> None:
+        cfg = self.config
+        t0 = time.time()
+        train_set = self._load_train_data()
+        valid_sets: List[Dataset] = []
+        valid_names: List[str] = []
+        valid = cfg.valid
+        if valid:
+            files = valid.split(",") if isinstance(valid, str) else list(valid)
+            for vf in files:
+                vf = vf.strip()
+                if not vf:
+                    continue
+                valid_sets.append(Dataset(vf, reference=train_set,
+                                          params=dict(self.params)))
+                valid_names.append(vf.rsplit("/", 1)[-1])
+
+        callbacks = []
+        if cfg.verbosity >= 0 and cfg.metric_freq > 0:
+            callbacks.append(callback_mod.log_evaluation(cfg.metric_freq))
+        if cfg.snapshot_freq > 0:
+            out_model = cfg.output_model
+            freq = cfg.snapshot_freq
+
+            def _snapshot(env):
+                it = env.iteration + 1
+                if it % freq == 0:
+                    env.model.save_model(f"{out_model}.snapshot_iter_{it}")
+            callbacks.append(_snapshot)
+
+        booster = train_fn(dict(self.params), train_set,
+                           num_boost_round=cfg.num_iterations,
+                           valid_sets=valid_sets, valid_names=valid_names,
+                           callbacks=callbacks)
+        booster.save_model(cfg.output_model)
+        if cfg.verbosity >= 0:
+            print(f"[LightGBM-TPU] finished training in "
+                  f"{time.time() - t0:.3f} s; model saved to "
+                  f"{cfg.output_model}")
+
+    # ------------------------------------------------------------------
+    def _predict(self) -> None:
+        cfg = self.config
+        if not cfg.input_model:
+            raise LightGBMError("task=predict requires input_model=")
+        if not cfg.data:
+            raise LightGBMError("task=predict requires data=")
+        booster = Booster(model_file=cfg.input_model)
+        from .io.text_loader import load_svmlight_or_csv
+        data, _label, _w, _g = load_svmlight_or_csv(cfg.data,
+                                                    dict(self.params))
+        # align width with the model (ref: predict_disable_shape_check)
+        need = booster.num_feature()
+        if data.shape[1] < need:
+            pad = np.full((data.shape[0], need - data.shape[1]), np.nan)
+            data = np.hstack([data, pad])
+        elif data.shape[1] > need and not cfg.predict_disable_shape_check:
+            data = data[:, :need]
+        preds = booster.predict(
+            data,
+            start_iteration=cfg.start_iteration_predict,
+            num_iteration=cfg.num_iteration_predict,
+            raw_score=cfg.predict_raw_score,
+            pred_leaf=cfg.predict_leaf_index,
+            pred_contrib=cfg.predict_contrib)
+        preds = np.asarray(preds)
+        with open(cfg.output_result, "w") as fh:
+            if preds.ndim == 1:
+                for v in preds:
+                    fh.write(f"{v:g}\n")
+            else:
+                for row in preds:
+                    fh.write("\t".join(f"{v:g}" for v in row) + "\n")
+        if cfg.verbosity >= 0:
+            print(f"[LightGBM-TPU] predictions for {preds.shape[0]} rows "
+                  f"written to {cfg.output_result}")
+
+    # ------------------------------------------------------------------
+    def _convert_model(self) -> None:
+        """Model -> standalone C++ if-else source
+        (ref: task=convert_model, GBDT::SaveModelToIfElse tree.h:253)."""
+        cfg = self.config
+        if not cfg.input_model:
+            raise LightGBMError("task=convert_model requires input_model=")
+        from .codegen import model_to_if_else
+        with open(cfg.input_model) as fh:
+            from .model_io import load_model_from_string
+            model = load_model_from_string(fh.read())
+        code = model_to_if_else(model)
+        with open(cfg.convert_model, "w") as fh:
+            fh.write(code)
+        if cfg.verbosity >= 0:
+            print(f"[LightGBM-TPU] model converted to {cfg.convert_model}")
+
+    # ------------------------------------------------------------------
+    def _refit(self) -> None:
+        """Refresh leaf values of input_model on new data
+        (ref: task=refit, GBDT::RefitTree gbdt.cpp:267)."""
+        cfg = self.config
+        if not cfg.input_model:
+            raise LightGBMError("task=refit requires input_model=")
+        from .io.text_loader import load_svmlight_or_csv
+        data, label, weight, _g = load_svmlight_or_csv(cfg.data,
+                                                       dict(self.params))
+        booster = Booster(model_file=cfg.input_model)
+        new_booster = booster.refit(data, label,
+                                    decay_rate=cfg.refit_decay_rate)
+        new_booster.save_model(cfg.output_model)
+        if cfg.verbosity >= 0:
+            print(f"[LightGBM-TPU] refitted model saved to "
+                  f"{cfg.output_model}")
+
+    # ------------------------------------------------------------------
+    def _save_binary(self) -> None:
+        """Bin the dataset and store the binned form for fast reload
+        (ref: task=save_binary, Dataset::SaveBinaryFile dataset.h:710)."""
+        cfg = self.config
+        ds = self._load_train_data()
+        out = cfg.data + ".bin"
+        ds.save_binary(out)
+        if cfg.verbosity >= 0:
+            print(f"[LightGBM-TPU] binned dataset saved to {out}")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if not argv:
+        print("usage: python -m lightgbm_tpu config=<file> [key=value ...]")
+        return 1
+    try:
+        Application(argv).run()
+    except (LightGBMError, OSError, ValueError) as exc:
+        print(f"[LightGBM-TPU] [Fatal] {exc}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

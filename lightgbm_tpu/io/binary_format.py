@@ -1,0 +1,134 @@
+"""Binned-dataset binary serialization (fast reload path).
+
+(ref: Dataset::SaveBinaryFile / SerializeReference dataset.h:710,715 and
+the loader fast path LoadFromBinFile dataset_loader.cpp:425.) The on-disk
+container is a single .npz archive: numeric arrays verbatim plus one JSON
+header for mapper/meta structure — a TPU-first choice (the bin matrix is
+exactly what ships to the device, so reload is one mmap + one transfer)
+rather than the reference's custom byte layout.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+from typing import List, Optional
+
+import numpy as np
+
+_MAGIC = "lightgbm_tpu.dataset.v1"
+
+
+def _mapper_state(m) -> dict:
+    return {
+        "num_bins": int(m.num_bins),
+        "is_categorical": bool(m.is_categorical),
+        "missing_type": int(m.missing_type),
+        "default_bin": int(m.default_bin),
+        "most_freq_bin": int(m.most_freq_bin),
+        "min_value": float(m.min_value),
+        "max_value": float(m.max_value),
+        "is_trivial": bool(m.is_trivial),
+        "bin_upper_bound": None if m.bin_upper_bound is None
+        else [float(v) for v in m.bin_upper_bound],
+        "cat_bin_to_value": None if m.cat_bin_to_value is None
+        else [int(v) for v in m.cat_bin_to_value],
+    }
+
+
+def _mapper_from_state(state: dict):
+    from ..binning import BinMapper
+    m = BinMapper()
+    m.num_bins = state["num_bins"]
+    m.is_categorical = state["is_categorical"]
+    m.missing_type = state["missing_type"]
+    m.default_bin = state["default_bin"]
+    m.most_freq_bin = state["most_freq_bin"]
+    m.min_value = state["min_value"]
+    m.max_value = state["max_value"]
+    m.is_trivial = state["is_trivial"]
+    if state["bin_upper_bound"] is not None:
+        m.bin_upper_bound = np.asarray(state["bin_upper_bound"], np.float64)
+    if state["cat_bin_to_value"] is not None:
+        vals = np.asarray(state["cat_bin_to_value"], np.float64)
+        m.cat_bin_to_value = vals
+        m.cat_value_to_bin = {int(v): i + 1 for i, v in enumerate(vals)}
+        order = np.argsort(vals)
+        m._cat_sorted_vals = vals[order]
+        m._cat_sorted_bins = (order + 1).astype(np.int32)
+    return m
+
+
+def save_dataset_binary(dataset, filename) -> None:
+    """dataset: basic.Dataset (constructed)."""
+    binned = dataset._binned
+    meta = binned.metadata
+    header = {
+        "magic": _MAGIC,
+        "num_total_features": binned.num_total_features,
+        "used_features": [int(c) for c in binned.used_features],
+        "feature_names": list(binned.feature_names),
+        "label_idx": int(binned.label_idx),
+        "mappers": [_mapper_state(m) for m in binned.mappers],
+    }
+    arrays = {"bins_fm": binned.bins_fm,
+              "header": np.frombuffer(
+                  json.dumps(header).encode(), dtype=np.uint8)}
+    for name in ("label", "weight", "init_score", "query_boundaries",
+                 "positions"):
+        value = getattr(meta, name)
+        if value is not None:
+            arrays["meta_" + name] = value
+    with open(filename, "wb") as fh:
+        np.savez_compressed(fh, **arrays)
+
+
+def load_dataset_binary(filename):
+    """Returns a constructed basic.Dataset backed by the stored bins
+    (raw data unavailable — prediction on raw values needs the original
+    file, same as the reference's binary datasets)."""
+    from ..basic import Dataset
+    from ..dataset import BinnedDataset, Metadata
+
+    with np.load(filename, allow_pickle=False) as z:
+        header = json.loads(bytes(z["header"].tobytes()).decode())
+        if header.get("magic") != _MAGIC:
+            raise ValueError(f"{filename}: not a lightgbm_tpu binary dataset")
+        bins_fm = z["bins_fm"]
+        meta = Metadata(bins_fm.shape[1])
+        if "meta_label" in z:
+            meta.set_label(z["meta_label"])
+        else:
+            meta.set_label(np.zeros(bins_fm.shape[1]))
+        if "meta_weight" in z:
+            meta.set_weight(z["meta_weight"])
+        if "meta_init_score" in z:
+            meta.set_init_score(z["meta_init_score"])
+        if "meta_query_boundaries" in z:
+            meta.query_boundaries = np.asarray(z["meta_query_boundaries"],
+                                               np.int32)
+        if "meta_positions" in z:
+            meta.positions = np.asarray(z["meta_positions"], np.int32)
+
+    mappers = [_mapper_from_state(s) for s in header["mappers"]]
+    binned = BinnedDataset(
+        bins_fm, mappers, header["used_features"],
+        header["num_total_features"], meta,
+        feature_names=header["feature_names"],
+        label_idx=header["label_idx"])
+
+    ds = Dataset.__new__(Dataset)
+    ds.data = None
+    ds.label = meta.label
+    ds.weight = meta.weight
+    ds.group = None
+    ds.init_score = meta.init_score
+    ds.position = meta.positions
+    ds.reference = None
+    ds.feature_name = header["feature_names"]
+    ds.categorical_feature = "auto"
+    ds.params = {}
+    ds.free_raw_data = True
+    ds._binned = binned
+    ds.used_indices = None
+    return ds

@@ -127,6 +127,18 @@ class LoadedModel:
             out /= (end - start_iteration)
         return out
 
+    def predict_leaf(self, data: np.ndarray, start_iteration: int = 0,
+                     num_iteration: int = -1) -> np.ndarray:
+        """[N, num_trees] leaf indices (ref: PredictLeafIndex tree.h:138)."""
+        data = np.asarray(data, np.float64)
+        k = self.num_tree_per_iteration
+        end = self.num_iterations if num_iteration < 0 else min(
+            self.num_iterations, start_iteration + num_iteration)
+        cols = [self.trees[it * k + ki].predict_leaf(data)
+                for it in range(start_iteration, end) for ki in range(k)]
+        return np.stack(cols, axis=1) if cols else \
+            np.zeros((data.shape[0], 0), np.int32)
+
     def predict(self, data: np.ndarray, raw_score: bool = False,
                 **kwargs) -> np.ndarray:
         raw = self.predict_raw(data, **kwargs)
@@ -151,6 +163,51 @@ class LoadedModel:
         if obj == "cross_entropy":
             return 1.0 / (1.0 + np.exp(-raw))
         return raw
+
+
+def loaded_model_to_string(model: LoadedModel, num_iteration: int = -1,
+                           start_iteration: int = 0) -> str:
+    """Serialize a LoadedModel back to the text format (used by refit /
+    model surgery on models loaded from file)."""
+    k = max(model.num_tree_per_iteration, 1)
+    end = model.num_iterations if num_iteration < 0 else min(
+        model.num_iterations, start_iteration + num_iteration)
+    trees = model.trees[start_iteration * k:end * k]
+
+    header = ["tree", "version=v4"]
+    header.append(f"num_class={model.num_class}")
+    header.append(f"num_tree_per_iteration={model.num_tree_per_iteration}")
+    header.append(f"label_index={model.label_index}")
+    header.append(f"max_feature_idx={model.max_feature_idx}")
+    header.append(f"objective={model.objective_str}")
+    if model.average_output:
+        header.append("average_output")
+    header.append("feature_names=" + " ".join(model.feature_names))
+    header.append("feature_infos=" + " ".join(model.feature_infos))
+
+    blocks = [tree.to_string(i) + "\n" for i, tree in enumerate(trees)]
+    header.append("tree_sizes=" + " ".join(
+        str(len(b.encode())) for b in blocks))
+    header.append("")
+    out = "\n".join(header) + "\n" + "".join(blocks)
+    out += "end of trees\n\n"
+
+    imp: dict = {}
+    for tree in trees:
+        for feat in tree.split_feature[:tree.num_internal]:
+            imp[int(feat)] = imp.get(int(feat), 0) + 1
+    lines = ["feature_importances:"]
+    for feat in sorted(imp, key=lambda i: -imp[i]):
+        name = (model.feature_names[feat]
+                if feat < len(model.feature_names) else f"Column_{feat}")
+        lines.append(f"{name}={imp[feat]}")
+    out += "\n".join(lines) + "\n\n"
+
+    out += "parameters:\n"
+    for key, value in model.params.items():
+        out += f"[{key}: {value}]\n"
+    out += "end of parameters\n\npandas_categorical:null\n"
+    return out
 
 
 def load_model_from_string(text: str) -> LoadedModel:

@@ -19,7 +19,18 @@ def refit_booster(booster, data, label, decay_rate: float = 0.9):
 
     new_booster = Booster(model_str=booster.model_to_string())
     gbdt = booster._gbdt
-    cfg = gbdt.config
+    if gbdt is not None:
+        cfg = gbdt.config
+    else:
+        # booster was loaded from file: rebuild config from the model's
+        # stored parameters block (ref: task=refit loads input_model)
+        from .config import Config
+        loaded = booster._loaded
+        params = dict(loaded.params)
+        params["objective"] = loaded.objective_str.split()[0]
+        if loaded.num_class > 1:
+            params["num_class"] = loaded.num_class
+        cfg = Config.from_params(params)
 
     # leaf assignments of new data under existing structures
     leaf_preds = booster.predict(data, pred_leaf=True)  # [N, T]
@@ -35,7 +46,8 @@ def refit_booster(booster, data, label, decay_rate: float = 0.9):
     obj.init(meta, len(label))
 
     import jax.numpy as jnp
-    k = gbdt.num_tree_per_iteration
+    k = (gbdt.num_tree_per_iteration if gbdt is not None
+         else max(new_booster._loaded.num_tree_per_iteration, 1))
     scores = np.zeros((k, len(label)), np.float32)
     t = 0
     loaded = new_booster._loaded

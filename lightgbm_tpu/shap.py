@@ -145,19 +145,18 @@ def _expected_value(tree) -> float:
     return float(np.sum(tree.leaf_value * tree.leaf_count) / total)
 
 
-def predict_contrib(booster, data: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
-    data = np.asarray(data, np.float64)
-    n, f_raw = data.shape
-    k = booster.num_tree_per_iteration
-    num_feat = booster.train_set.num_total_features
+def _contrib_over_trees(tree_of, n_iters: int, k: int, data: np.ndarray,
+                        num_feat: int, start_iteration: int,
+                        num_iteration: int) -> np.ndarray:
+    """Shared TreeSHAP accumulation. tree_of(it, ki) -> Tree."""
+    n = data.shape[0]
     out = np.zeros((n, k, num_feat + 1))
-    end = len(booster.models) if num_iteration < 0 else min(
-        len(booster.models), start_iteration + num_iteration)
+    end = n_iters if num_iteration < 0 else min(
+        n_iters, start_iteration + num_iteration)
     for it in range(start_iteration, end):
-        for ki, tree in enumerate(booster.models[it]):
-            base = _expected_value(tree)
-            out[:, ki, -1] += base
+        for ki in range(k):
+            tree = tree_of(it, ki)
+            out[:, ki, -1] += _expected_value(tree)
             if tree.num_internal == 0:
                 continue
             for r in range(n):
@@ -166,3 +165,22 @@ def predict_contrib(booster, data: np.ndarray, start_iteration: int = 0,
                 out[r, ki, :-1] += phi[:-1]
     return out.reshape(n, k * (num_feat + 1)) if k > 1 else \
         out.reshape(n, num_feat + 1)
+
+
+def loaded_pred_contrib(model, data: np.ndarray, start_iteration: int = 0,
+                        num_iteration: int = -1) -> np.ndarray:
+    """SHAP values for a model loaded from text (model_io.LoadedModel)."""
+    data = np.asarray(data, np.float64)
+    k = max(model.num_tree_per_iteration, 1)
+    return _contrib_over_trees(
+        lambda it, ki: model.trees[it * k + ki], model.num_iterations, k,
+        data, model.max_feature_idx + 1, start_iteration, num_iteration)
+
+
+def predict_contrib(booster, data: np.ndarray, start_iteration: int = 0,
+                    num_iteration: int = -1) -> np.ndarray:
+    data = np.asarray(data, np.float64)
+    return _contrib_over_trees(
+        lambda it, ki: booster.models[it][ki], len(booster.models),
+        booster.num_tree_per_iteration, data,
+        booster.train_set.num_total_features, start_iteration, num_iteration)

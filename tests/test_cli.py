@@ -1,0 +1,214 @@
+"""CLI application tests (ref: the reference CLI examples/*/train.conf
+workflow and tests/python_package_test/test_consistency.py pattern)."""
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+from conftest import make_binary, make_multiclass
+
+from lightgbm_tpu import Booster, Dataset
+from lightgbm_tpu.cli import main, parse_cli_args
+
+
+def _write_tsv(path, X, y):
+    with open(path, "w") as fh:
+        for label, row in zip(y, X):
+            fh.write("\t".join([f"{label:g}"] + [f"{v:.6f}" for v in row]))
+            fh.write("\n")
+
+
+@pytest.fixture
+def binary_files(tmp_path):
+    X, y = make_binary(600, 6)
+    Xt, yt = make_binary(200, 6, seed=1)
+    train = tmp_path / "b.train"
+    test = tmp_path / "b.test"
+    _write_tsv(train, X, y)
+    _write_tsv(test, Xt, yt)
+    return train, test, (X, y, Xt, yt)
+
+
+def test_parse_cli_args_precedence(tmp_path):
+    conf = tmp_path / "t.conf"
+    conf.write_text("num_trees = 50  # comment\nobjective=binary\n"
+                    "# full-line comment\nlearning_rate = 0.2\n")
+    params = parse_cli_args([f"config={conf}", "num_trees=7"])
+    assert params["num_iterations"] == "7"     # CLI wins, alias resolved
+    assert params["objective"] == "binary"
+    assert params["learning_rate"] == "0.2"
+
+
+def test_cli_train_and_predict(tmp_path, binary_files):
+    train, test, (X, y, Xt, yt) = binary_files
+    model = tmp_path / "model.txt"
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        f"task = train\nobjective = binary\ndata = {train}\n"
+        f"valid_data = {test}\nnum_trees = 10\nnum_leaves = 15\n"
+        f"metric = binary_logloss,auc\noutput_model = {model}\n"
+        "verbosity = -1\n")
+    assert main([f"config={conf}"]) == 0
+    assert model.exists()
+
+    out = tmp_path / "preds.txt"
+    assert main([f"task=predict", f"data={test}", f"input_model={model}",
+                 f"output_result={out}", "verbosity=-1"]) == 0
+    preds = np.loadtxt(out)
+    assert preds.shape == (200,)
+    assert np.all((preds >= 0) & (preds <= 1))
+    # predictions should separate classes reasonably
+    assert preds[yt == 1].mean() > preds[yt == 0].mean() + 0.1
+
+
+def test_cli_predict_matches_python_api(tmp_path, binary_files):
+    train, test, (X, y, Xt, yt) = binary_files
+    model = tmp_path / "model.txt"
+    assert main([f"task=train", f"data={train}", "objective=binary",
+                 "num_trees=5", f"output_model={model}",
+                 "verbosity=-1"]) == 0
+    out = tmp_path / "p.txt"
+    assert main([f"task=predict", f"data={test}", f"input_model={model}",
+                 f"output_result={out}", "verbosity=-1"]) == 0
+    cli_preds = np.loadtxt(out)
+    api_preds = Booster(model_file=str(model)).predict(Xt)
+    np.testing.assert_allclose(cli_preds, api_preds, rtol=1e-4)
+
+
+def test_cli_refit_task(tmp_path, binary_files):
+    train, test, _ = binary_files
+    model = tmp_path / "model.txt"
+    refitted = tmp_path / "refitted.txt"
+    assert main([f"task=train", f"data={train}", "objective=binary",
+                 "num_trees=5", f"output_model={model}", "verbosity=-1"]) == 0
+    assert main([f"task=refit", f"data={test}", f"input_model={model}",
+                 f"output_model={refitted}", "verbosity=-1"]) == 0
+    assert refitted.exists()
+    bst = Booster(model_file=str(refitted))
+    assert bst.num_trees() == 5
+
+
+def test_cli_save_binary_and_train_from_binary(tmp_path, binary_files):
+    train, test, (X, y, Xt, yt) = binary_files
+    assert main([f"task=save_binary", f"data={train}", "objective=binary",
+                 "verbosity=-1"]) == 0
+    bin_file = str(train) + ".bin"
+    assert os.path.exists(bin_file)
+    model = tmp_path / "model_from_bin.txt"
+    assert main([f"task=train", f"data={bin_file}", "objective=binary",
+                 "num_trees=5", f"output_model={model}", "verbosity=-1"]) == 0
+    bst = Booster(model_file=str(model))
+    preds = bst.predict(Xt)
+    assert preds[yt == 1].mean() > preds[yt == 0].mean()
+
+
+def test_cli_snapshot_freq(tmp_path, binary_files):
+    train, _test, _ = binary_files
+    model = tmp_path / "m.txt"
+    assert main([f"task=train", f"data={train}", "objective=binary",
+                 "num_trees=6", "snapshot_freq=2", f"output_model={model}",
+                 "verbosity=-1"]) == 0
+    assert (tmp_path / "m.txt.snapshot_iter_2").exists()
+    assert (tmp_path / "m.txt.snapshot_iter_4").exists()
+
+
+def test_cli_multiclass_predict_output(tmp_path):
+    X, y = make_multiclass(400, 6, k=3)
+    train = tmp_path / "mc.train"
+    _write_tsv(train, X, y)
+    model = tmp_path / "mc_model.txt"
+    assert main([f"task=train", f"data={train}", "objective=multiclass",
+                 "num_class=3", "num_trees=5", f"output_model={model}",
+                 "verbosity=-1"]) == 0
+    out = tmp_path / "mc_preds.txt"
+    assert main([f"task=predict", f"data={train}", f"input_model={model}",
+                 f"output_result={out}", "verbosity=-1"]) == 0
+    preds = np.loadtxt(out)
+    assert preds.shape == (400, 3)
+    np.testing.assert_allclose(preds.sum(axis=1), 1.0, atol=1e-5)
+
+
+def test_convert_model_compiles_and_matches(tmp_path, binary_files):
+    """task=convert_model emits C++ that g++ compiles; the compiled
+    predictor must agree with the framework (ref: Tree::ToIfElse)."""
+    train, test, (X, y, Xt, yt) = binary_files
+    model = tmp_path / "model.txt"
+    assert main([f"task=train", f"data={train}", "objective=binary",
+                 "num_trees=4", "num_leaves=8", f"output_model={model}",
+                 "verbosity=-1"]) == 0
+    cpp = tmp_path / "pred.cpp"
+    assert main([f"task=convert_model", f"input_model={model}",
+                 f"convert_model={cpp}", "verbosity=-1"]) == 0
+    text = cpp.read_text()
+    assert "PredictTree0" in text and "void Predict" in text
+
+    so = tmp_path / "pred.so"
+    wrapper = tmp_path / "wrap.cpp"
+    wrapper.write_text(
+        '#include "pred.cpp"\nextern "C" void PredictRows('
+        "const double* rows, int n, int f, double* out) {\n"
+        "  for (int i = 0; i < n; ++i) Predict(rows + i * f, out + i);\n}\n")
+    subprocess.run(["g++", "-O2", "-shared", "-fPIC", str(wrapper),
+                    "-o", str(so)], check=True, cwd=tmp_path)
+    lib = ctypes.CDLL(str(so))
+    n, f = Xt.shape
+    out = np.zeros(n)
+    lib.PredictRows(
+        np.ascontiguousarray(Xt).ctypes.data_as(
+            ctypes.POINTER(ctypes.c_double)),
+        ctypes.c_int(n), ctypes.c_int(f),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
+    expected = Booster(model_file=str(model)).predict(Xt, raw_score=True)
+    np.testing.assert_allclose(out, expected, rtol=1e-10)
+
+
+def test_binary_dataset_roundtrip(tmp_path):
+    X, y = make_binary(300, 5)
+    w = np.abs(np.random.RandomState(0).randn(300)) + 0.5
+    ds = Dataset(X, label=y, weight=w)
+    ds.construct()
+    path = tmp_path / "d.bin"
+    ds.save_binary(path)
+    from lightgbm_tpu.io.binary_format import load_dataset_binary
+    ds2 = load_dataset_binary(path)
+    np.testing.assert_array_equal(ds._binned.bins_fm, ds2._binned.bins_fm)
+    np.testing.assert_allclose(ds._binned.metadata.label,
+                               ds2._binned.metadata.label)
+    np.testing.assert_allclose(ds._binned.metadata.weight,
+                               ds2._binned.metadata.weight)
+    assert [m.num_bins for m in ds._binned.mappers] == \
+        [m.num_bins for m in ds2._binned.mappers]
+
+
+REF_EXAMPLES = "/root/reference/examples"
+
+
+@pytest.mark.skipif(not os.path.isdir(REF_EXAMPLES),
+                    reason="reference examples not mounted")
+def test_cli_on_reference_binary_example(tmp_path):
+    """Train on the reference's example config/data (read-only mount) —
+    the test_consistency.py pattern from SURVEY.md §4."""
+    conf = os.path.join(REF_EXAMPLES, "binary_classification", "train.conf")
+    model = tmp_path / "ref_model.txt"
+    cwd = os.getcwd()
+    os.chdir(os.path.join(REF_EXAMPLES, "binary_classification"))
+    try:
+        assert main([f"config={conf}", "num_trees=10",
+                     f"output_model={model}", "verbosity=-1"]) == 0
+    finally:
+        os.chdir(cwd)
+    bst = Booster(model_file=str(model))
+    assert bst.num_trees() == 10
+    # evaluate on the example's test split
+    from lightgbm_tpu.io.text_loader import load_svmlight_or_csv
+    data, label, weight, _ = load_svmlight_or_csv(
+        os.path.join(REF_EXAMPLES, "binary_classification", "binary.test"),
+        {})
+    preds = bst.predict(data)
+    pos, neg = preds[label == 1], preds[label == 0]
+    auc = (pos[:, None] > neg[None, :]).mean() + \
+        0.5 * (pos[:, None] == neg[None, :]).mean()
+    assert auc > 0.7
