@@ -65,11 +65,37 @@ class Dataset:
                                       (init_score, self.set_init_score)):
                     if value is not None:
                         setter(value)
-                if params:
+                if reference is not None:
+                    # stored bins must match the reference's mappers, or
+                    # eval would silently run on mis-binned data
+                    reference.construct()
+                    ref_b, own_b = reference._binned, self._binned
+                    same = (len(ref_b.mappers) == len(own_b.mappers) and all(
+                        rm.num_bins == om.num_bins and
+                        rm.is_categorical == om.is_categorical and
+                        (rm.bin_upper_bound is None or
+                         om.bin_upper_bound is None or
+                         np.array_equal(rm.bin_upper_bound,
+                                        om.bin_upper_bound))
+                        for rm, om in zip(ref_b.mappers, own_b.mappers)))
+                    if not same:
+                        raise LightGBMError(
+                            f"binary dataset {path} was binned differently "
+                            "from the reference dataset; rebuild it with "
+                            "save_binary against the same training data, "
+                            "or pass the text file instead")
+                    self.reference = reference
+                _DATASET_PARAM_KEYS = {
+                    "max_bin", "max_bin_by_feature", "min_data_in_bin",
+                    "bin_construct_sample_cnt", "use_missing",
+                    "zero_as_missing", "feature_pre_filter",
+                    "categorical_feature", "forcedbins_filename"}
+                dropped = _DATASET_PARAM_KEYS & set(params or {})
+                if dropped:
                     import warnings
                     warnings.warn(
-                        "dataset params are ignored when loading a binary "
-                        "dataset file (binning is already fixed)")
+                        f"dataset params {sorted(dropped)} are ignored when "
+                        "loading a binary dataset file (binning is fixed)")
                 return
             from .io.text_loader import load_svmlight_or_csv
             data, file_label, file_weight, file_group = \
@@ -186,9 +212,13 @@ class Dataset:
         return self.data
 
     def num_data(self) -> int:
+        if self.data is None and self._binned is not None:
+            return self._binned.num_data
         return self.data.shape[0]
 
     def num_feature(self) -> int:
+        if self.data is None and self._binned is not None:
+            return self._binned.num_total_features
         return self.data.shape[1]
 
     def get_feature_name(self) -> List[str]:
@@ -197,6 +227,10 @@ class Dataset:
     def subset(self, used_indices: Sequence[int],
                params: Optional[Dict] = None) -> "Dataset":
         """Row-subset view (ref: basic.py Dataset.subset)."""
+        if self.data is None:
+            raise LightGBMError(
+                "cannot subset a dataset loaded from a binary file "
+                "(raw feature values are not stored)")
         idx = np.asarray(used_indices)
         sub = Dataset(
             self.data[idx],
@@ -398,10 +432,11 @@ class Booster:
                                   pred_leaf=pred_leaf,
                                   pred_contrib=pred_contrib)
 
-    def refit(self, data, label, decay_rate: float = 0.9, **kwargs):
+    def refit(self, data, label, decay_rate: float = 0.9, weight=None,
+              **kwargs):
         """(ref: Booster.refit basic.py; GBDT::RefitTree gbdt.cpp:267)"""
         from .refit import refit_booster
-        return refit_booster(self, data, label, decay_rate)
+        return refit_booster(self, data, label, decay_rate, weight=weight)
 
     # ------------------------------------------------------------------
     def model_to_string(self, num_iteration: int = -1,
@@ -410,7 +445,7 @@ class Booster:
         if self._loaded is not None:
             from .model_io import loaded_model_to_string
             return loaded_model_to_string(self._loaded, num_iteration,
-                                          start_iteration)
+                                          start_iteration, importance_type)
         return save_model_to_string(self._gbdt, num_iteration,
                                     start_iteration, importance_type)
 

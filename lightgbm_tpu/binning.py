@@ -136,13 +136,26 @@ class BinMapper:
         self.min_value = float(clean.min())
         self.max_value = float(clean.max())
 
-        distinct, counts = np.unique(clean, return_counts=True)
-
         if forced_bounds is not None and len(forced_bounds) > 0:
             inner = sorted(float(b) for b in forced_bounds
                            if self.min_value < b < self.max_value)
             bounds = inner + [np.inf]
         else:
+            # native fast path (bit-identical; see native/src) — before
+            # np.unique, which is the dominant cost it replaces
+            from . import native as _native
+            nb = _native.find_numerical_bounds(
+                values, max_bin, min_data_in_bin, self.missing_type,
+                zero_as_missing)
+            if nb is not None:
+                self.bin_upper_bound = nb
+                self.num_bins = len(nb)
+                if self.missing_type == MISSING_NAN:
+                    self.num_bins += 1
+                self._finalize_numerical(values, na_cnt)
+                return self
+
+            distinct, counts = np.unique(clean, return_counts=True)
             # zero-as-one-bin (ref: bin.cpp:247): bin the negative and
             # positive halves separately, keep [-eps, eps] as zero's own bin
             neg = distinct < -K_ZERO_THRESHOLD
@@ -248,6 +261,13 @@ class BinMapper:
                 out = np.where(hit, self._cat_sorted_bins[pos], 0).astype(np.int32)
             return out
 
+        if values.size >= 65536 and values.ndim == 1:
+            from . import native as _native
+            nb = _native.transform_column(
+                values, self.bin_upper_bound, self.missing_type,
+                self.default_bin, self.num_bins)
+            if nb is not None:
+                return nb
         na_mask = np.isnan(values)
         if self.missing_type == MISSING_ZERO:
             values = np.where(na_mask, 0.0, values)

@@ -139,13 +139,20 @@ class Application:
         from .io.text_loader import load_svmlight_or_csv
         data, _label, _w, _g = load_svmlight_or_csv(cfg.data,
                                                     dict(self.params))
-        # align width with the model (ref: predict_disable_shape_check)
+        # feature-count check (ref: predict_disable_shape_check — the
+        # reference aborts on mismatch unless the check is disabled)
         need = booster.num_feature()
-        if data.shape[1] < need:
-            pad = np.full((data.shape[0], need - data.shape[1]), np.nan)
-            data = np.hstack([data, pad])
-        elif data.shape[1] > need and not cfg.predict_disable_shape_check:
-            data = data[:, :need]
+        if data.shape[1] != need:
+            if not cfg.predict_disable_shape_check:
+                raise LightGBMError(
+                    f"prediction data has {data.shape[1]} features but the "
+                    f"model expects {need}; set "
+                    "predict_disable_shape_check=true to pad/truncate")
+            if data.shape[1] < need:
+                pad = np.full((data.shape[0], need - data.shape[1]), np.nan)
+                data = np.hstack([data, pad])
+            else:
+                data = data[:, :need]
         preds = booster.predict(
             data,
             start_iteration=cfg.start_iteration_predict,
@@ -193,7 +200,7 @@ class Application:
         data, label, weight, _g = load_svmlight_or_csv(cfg.data,
                                                        dict(self.params))
         booster = Booster(model_file=cfg.input_model)
-        new_booster = booster.refit(data, label,
+        new_booster = booster.refit(data, label, weight=weight,
                                     decay_rate=cfg.refit_decay_rate)
         new_booster.save_model(cfg.output_model)
         if cfg.verbosity >= 0:

@@ -19,6 +19,32 @@ from .binning import BinMapper
 from .config import Config
 
 
+def _transform_all(data: np.ndarray, mappers: List[BinMapper],
+                   used: Sequence[int], dtype) -> np.ndarray:
+    """Bin all used columns -> [F_used, N]. Uses the native threaded
+    transform for the numerical columns when the library is available
+    (ref: the reference bins in C++; here native/src LGT_TransformMatrix)."""
+    n = data.shape[0]
+    bins_fm = np.empty((len(used), n), dtype=dtype)
+    numeric = [j for j, m in enumerate(mappers) if not m.is_categorical
+               and m.bin_upper_bound is not None]
+    done = set()
+    if len(numeric) > 1 and n * len(numeric) >= 65536:
+        from . import native as _native
+        sub = np.ascontiguousarray(
+            data[:, [used[j] for j in numeric]], np.float64)
+        out = _native.transform_matrix(sub, [mappers[j] for j in numeric],
+                                       dtype)
+        if out is not None:
+            for k, j in enumerate(numeric):
+                bins_fm[j] = out[k]
+            done = set(numeric)
+    for j, col in enumerate(used):
+        if j not in done:
+            bins_fm[j] = mappers[j].transform(data[:, col])
+    return bins_fm
+
+
 class Metadata:
     """Labels, weights, init scores, query boundaries
     (ref: include/LightGBM/dataset.h:49)."""
@@ -140,9 +166,8 @@ class BinnedDataset:
             # (ref: dataset_loader.cpp:307 LoadFromFileAlignWithOtherDataset)
             mappers = reference.mappers
             used = reference.used_features
-            bins_fm = np.empty((len(used), n), dtype=reference.bins_fm.dtype)
-            for j, col in enumerate(used):
-                bins_fm[j] = mappers[j].transform(data[:, col])
+            bins_fm = _transform_all(data, mappers, used,
+                                     reference.bins_fm.dtype)
             return cls(bins_fm, mappers, used, reference.num_total_features,
                        metadata, reference.feature_names)
 
@@ -182,9 +207,7 @@ class BinnedDataset:
         mappers = [mappers_all[i] for i in used]
         max_bins = max((m.num_bins for m in mappers), default=1)
         dtype = np.uint8 if max_bins <= 256 else np.uint16
-        bins_fm = np.empty((len(used), n), dtype=dtype)
-        for j, col in enumerate(used):
-            bins_fm[j] = mappers[j].transform(data[:, col])
+        bins_fm = _transform_all(data, mappers, used, dtype)
         return cls(bins_fm, mappers, used, f, metadata, feature_names)
 
     # ------------------------------------------------------------------

@@ -42,6 +42,22 @@ def load_svmlight_or_csv(path: str, params: Dict
                      ).lower() in ("true", "1")
     label_column = params.get("label_column", params.get("label", ""))
 
+    # native parser fast path (ref: src/io/parser.hpp; built from
+    # native/src/lgbm_tpu_native.cpp). Name-based label columns need the
+    # header names, resolved here before delegating.
+    if not isinstance(label_column, str) or \
+            not label_column.startswith("name:"):
+        from .. import native as _native
+        label_idx_n = int(label_column) if str(label_column).isdigit() else 0
+        parsed = None
+        try:
+            parsed = _native.parse_file(path, label_idx_n, has_header)
+        except ValueError:
+            parsed = None  # malformed for the fast path; numpy decides
+        if parsed is not None:
+            data, label = parsed
+            return data, label, _sidecar_weight(path), _sidecar_group(path)
+
     with open(path) as fh:
         lines = [ln.rstrip("\n") for ln in fh]
     lines = [ln for ln in lines if ln.strip()]
@@ -86,23 +102,31 @@ def load_svmlight_or_csv(path: str, params: Dict
         label = labels
     else:
         sep = "," if fmt == "csv" else "\t"
-        mat = np.array(
-            [[_parse_float(x) for x in ln.split(sep)] for ln in lines],
-            dtype=np.float64)
+        rows = [[_parse_float(x) for x in ln.split(sep)] for ln in lines]
+        widths = {len(r) for r in rows}
+        if len(widths) > 1:
+            raise ValueError(
+                f"{path}: inconsistent column count across rows "
+                f"(saw {sorted(widths)})")
+        mat = np.array(rows, dtype=np.float64)
         label = mat[:, label_idx].copy()
         data = np.delete(mat, label_idx, axis=1)
 
-    weight = None
+    return data, label, _sidecar_weight(path), _sidecar_group(path)
+
+
+def _sidecar_weight(path: str) -> Optional[np.ndarray]:
     wfile = path + ".weight"
     if os.path.exists(wfile):
-        weight = np.loadtxt(wfile, dtype=np.float64).reshape(-1)
+        return np.loadtxt(wfile, dtype=np.float64).reshape(-1)
+    return None
 
-    group = None
+
+def _sidecar_group(path: str) -> Optional[np.ndarray]:
     qfile = path + ".query"
     if os.path.exists(qfile):
-        group = np.loadtxt(qfile, dtype=np.int64).reshape(-1)
-
-    return data, label, weight, group
+        return np.loadtxt(qfile, dtype=np.int64).reshape(-1)
+    return None
 
 
 def _parse_float(tok: str) -> float:

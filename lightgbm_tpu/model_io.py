@@ -166,7 +166,8 @@ class LoadedModel:
 
 
 def loaded_model_to_string(model: LoadedModel, num_iteration: int = -1,
-                           start_iteration: int = 0) -> str:
+                           start_iteration: int = 0,
+                           importance_type: str = "split") -> str:
     """Serialize a LoadedModel back to the text format (used by refit /
     model surgery on models loaded from file)."""
     k = max(model.num_tree_per_iteration, 1)
@@ -194,13 +195,16 @@ def loaded_model_to_string(model: LoadedModel, num_iteration: int = -1,
 
     imp: dict = {}
     for tree in trees:
-        for feat in tree.split_feature[:tree.num_internal]:
-            imp[int(feat)] = imp.get(int(feat), 0) + 1
+        for s in range(tree.num_internal):
+            feat = int(tree.split_feature[s])
+            add = float(tree.split_gain[s]) if importance_type == "gain" \
+                else 1
+            imp[feat] = imp.get(feat, 0) + add
     lines = ["feature_importances:"]
     for feat in sorted(imp, key=lambda i: -imp[i]):
         name = (model.feature_names[feat]
                 if feat < len(model.feature_names) else f"Column_{feat}")
-        lines.append(f"{name}={imp[feat]}")
+        lines.append(f"{name}={imp[feat]:g}")
     out += "\n".join(lines) + "\n\n"
 
     out += "parameters:\n"

@@ -1,0 +1,186 @@
+"""ctypes binding to the native host runtime (parser + binning).
+
+(ref: the reference's C++ IO layer — src/io/parser.hpp, src/io/bin.cpp;
+here a small C-ABI .so built from native/src/lgbm_tpu_native.cpp.)
+The library is built on demand with g++ on first import (cached next to
+the package); every entry point has a NumPy fallback, so the framework
+works even where no C++ toolchain exists. `LIGHTGBM_TPU_NO_NATIVE=1`
+disables the native path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_LIB_NAME = "liblgbm_tpu_native.so"
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_PKG_DIR, _LIB_NAME)
+_SRC_PATH = os.path.join(os.path.dirname(_PKG_DIR), "native", "src",
+                         "lgbm_tpu_native.cpp")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC_PATH):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+             _SRC_PATH, "-o", _LIB_PATH],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError, OSError):
+        return False
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, building it if needed; None if
+    unavailable (callers fall back to NumPy)."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
+            return None
+        if not os.path.exists(_LIB_PATH) or (
+                os.path.exists(_SRC_PATH) and
+                os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)):
+            if not _build() and not os.path.exists(_LIB_PATH):
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError:
+            return None
+        lib.LGT_ParseFile.restype = ctypes.c_void_p
+        lib.LGT_ParseFile.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                      ctypes.c_int]
+        lib.LGT_ParseNumRows.restype = ctypes.c_int64
+        lib.LGT_ParseNumRows.argtypes = [ctypes.c_void_p]
+        lib.LGT_ParseNumCols.restype = ctypes.c_int32
+        lib.LGT_ParseNumCols.argtypes = [ctypes.c_void_p]
+        lib.LGT_ParseError.restype = ctypes.c_char_p
+        lib.LGT_ParseError.argtypes = [ctypes.c_void_p]
+        lib.LGT_ParseCopy.restype = None
+        lib.LGT_ParseCopy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                      ctypes.c_void_p]
+        lib.LGT_ParseFree.restype = None
+        lib.LGT_ParseFree.argtypes = [ctypes.c_void_p]
+        lib.LGT_FindNumericalBounds.restype = ctypes.c_int32
+        lib.LGT_FindNumericalBounds.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p]
+        lib.LGT_TransformColumn.restype = None
+        lib.LGT_TransformColumn.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_int32, ctypes.c_int, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p]
+        lib.LGT_TransformMatrix.restype = None
+        lib.LGT_TransformMatrix.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+# ----------------------------------------------------------------------
+def parse_file(path: str, label_idx: int = 0, has_header: bool = False
+               ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Parse CSV/TSV/LibSVM -> (data [N, F] f64, label [N] f64), or None
+    if the native library is unavailable. Raises ValueError on malformed
+    input."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    handle = lib.LGT_ParseFile(path.encode(), int(label_idx),
+                               int(bool(has_header)))
+    try:
+        err = lib.LGT_ParseError(handle)
+        if err:
+            raise ValueError(err.decode())
+        n = lib.LGT_ParseNumRows(handle)
+        f = lib.LGT_ParseNumCols(handle)
+        data = np.empty((n, f), np.float64)
+        label = np.empty(n, np.float64)
+        lib.LGT_ParseCopy(handle, data.ctypes.data, label.ctypes.data)
+        return data, label
+    finally:
+        lib.LGT_ParseFree(handle)
+
+
+def find_numerical_bounds(values: np.ndarray, max_bin: int,
+                          min_data_in_bin: int, missing_type: int,
+                          zero_as_missing: bool) -> Optional[np.ndarray]:
+    """Numerical bin upper bounds (zero-as-one-bin semantics), or None."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, np.float64)
+    out = np.empty(max_bin + 2, np.float64)
+    nb = lib.LGT_FindNumericalBounds(
+        values.ctypes.data, len(values), int(max_bin),
+        int(min_data_in_bin), int(missing_type), int(bool(zero_as_missing)),
+        out.ctypes.data)
+    if nb < 0:
+        return None
+    return out[:nb].copy()
+
+
+def transform_column(values: np.ndarray, bounds: np.ndarray,
+                     missing_type: int, default_bin: int, num_bins: int
+                     ) -> Optional[np.ndarray]:
+    lib = get_lib()
+    if lib is None:
+        return None
+    values = np.ascontiguousarray(values, np.float64)
+    bounds = np.ascontiguousarray(bounds, np.float64)
+    out = np.empty(len(values), np.int32)
+    lib.LGT_TransformColumn(values.ctypes.data, len(values),
+                            bounds.ctypes.data, len(bounds),
+                            int(missing_type), int(default_bin),
+                            int(num_bins), out.ctypes.data)
+    return out
+
+
+def transform_matrix(data: np.ndarray, mappers, dtype) -> Optional[np.ndarray]:
+    """Bin all numerical columns at once (threaded over features).
+    `data` is [N, F_used] with columns already gathered; any categorical
+    mapper columns must be handled by the caller. Returns [F_used, N]."""
+    lib = get_lib()
+    if lib is None:
+        return None
+    n, f = data.shape
+    if any(m.is_categorical or m.bin_upper_bound is None for m in mappers):
+        return None
+    data_cm = np.asfortranarray(data, np.float64)
+    offsets = np.zeros(f + 1, np.int64)
+    for j, m in enumerate(mappers):
+        offsets[j + 1] = offsets[j] + len(m.bin_upper_bound)
+    bounds_flat = np.concatenate([m.bin_upper_bound for m in mappers]) \
+        .astype(np.float64)
+    missing = np.array([m.missing_type for m in mappers], np.int32)
+    default = np.array([m.default_bin for m in mappers], np.int32)
+    nbins = np.array([m.num_bins for m in mappers], np.int32)
+    elem = np.dtype(dtype).itemsize
+    out = np.empty((f, n), dtype=dtype)
+    lib.LGT_TransformMatrix(
+        data_cm.ctypes.data, n, f, bounds_flat.ctypes.data,
+        offsets.ctypes.data, missing.ctypes.data, default.ctypes.data,
+        nbins.ctypes.data, elem, out.ctypes.data)
+    return out

@@ -9,7 +9,8 @@ from __future__ import annotations
 import numpy as np
 
 
-def refit_booster(booster, data, label, decay_rate: float = 0.9):
+def refit_booster(booster, data, label, decay_rate: float = 0.9,
+                  weight=None):
     """Returns a new Booster whose leaf values are
     decay * old + (1 - decay) * new_leaf_optimum on `data`."""
     from .basic import Booster, Dataset
@@ -42,6 +43,8 @@ def refit_booster(booster, data, label, decay_rate: float = 0.9):
     from .objectives import create_objective
     meta = Metadata(len(label))
     meta.set_label(label)
+    if weight is not None:
+        meta.set_weight(weight)
     obj = create_objective(cfg)
     obj.init(meta, len(label))
 

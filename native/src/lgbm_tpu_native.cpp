@@ -1,0 +1,527 @@
+// lightgbm_tpu native host runtime: text parsing, quantile binning,
+// multithreaded bin transform.
+//
+// TPU-native counterpart of the reference's C++ IO layer
+// (ref: src/io/parser.hpp CSV/TSV/LibSVM parsers, src/io/bin.cpp:81
+// GreedyFindBin / :247 FindBinWithZeroAsOneBin, BinMapper::ValueToBin).
+// The compute path (histograms, split search) lives in XLA/Pallas; this
+// library covers the host-side data plane the reference implements in
+// C++: turning text into a dense matrix and a matrix into the bin tensor
+// that ships to the device. Exposed as a C ABI consumed via ctypes.
+//
+// Semantics intentionally bit-match lightgbm_tpu/binning.py (the portable
+// fallback); tests assert equality between the two paths.
+
+#include <algorithm>
+#include <atomic>
+#include <cctype>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <limits>
+#include <memory>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr double kZeroThreshold = 1e-35;
+constexpr int kMissingNone = 0;
+constexpr int kMissingZero = 1;
+constexpr int kMissingNan = 2;
+
+inline double FastAtof(const char* p, const char** end) {
+  char* e = nullptr;
+  double v = std::strtod(p, &e);
+  *end = e;
+  if (e == p) v = std::numeric_limits<double>::quiet_NaN();
+  return v;
+}
+
+inline bool IsNaToken(const char* s, size_t len) {
+  if (len == 0) return true;
+  if (len == 1 && *s == '?') return true;
+  static const char* kTokens[] = {"na", "nan", "null", "none"};
+  char buf[8];
+  if (len >= sizeof(buf)) return false;
+  for (size_t i = 0; i < len; ++i) buf[i] = std::tolower(s[i]);
+  buf[len] = 0;
+  for (const char* t : kTokens)
+    if (std::strcmp(buf, t) == 0) return true;
+  return false;
+}
+
+size_t NumThreads() {
+  unsigned hc = std::thread::hardware_concurrency();
+  return hc ? hc : 4;
+}
+
+// Run fn(t, begin, end) over [0, n) split across threads.
+template <typename F>
+void ParallelFor(size_t n, F fn) {
+  size_t nt = std::min(NumThreads(), n ? n : size_t(1));
+  if (nt <= 1) {
+    fn(0, 0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  size_t chunk = (n + nt - 1) / nt;
+  for (size_t t = 0; t < nt; ++t) {
+    size_t b = t * chunk, e = std::min(n, b + chunk);
+    if (b >= e) break;
+    threads.emplace_back(fn, t, b, e);
+  }
+  for (auto& th : threads) th.join();
+}
+
+struct ParseResult {
+  std::vector<double> data;   // row-major [n, f]
+  std::vector<double> label;  // [n]
+  int64_t num_rows = 0;
+  int32_t num_cols = 0;  // feature count (label excluded)
+  std::string error;
+};
+
+// ---------------------------------------------------------------------
+// Parsing. Format detection mirrors io/text_loader.py: a token with ':'
+// after the first -> libsvm; '\t' -> tsv; ',' -> csv.
+// ---------------------------------------------------------------------
+
+std::vector<std::pair<const char*, const char*>> SplitLines(
+    const char* buf, size_t len) {
+  std::vector<std::pair<const char*, const char*>> lines;
+  const char* p = buf;
+  const char* end = buf + len;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(memchr(p, '\n', end - p));
+    const char* le = nl ? nl : end;
+    const char* trimmed = le;
+    while (trimmed > p && (trimmed[-1] == '\r' || trimmed[-1] == ' ')) {
+      --trimmed;
+    }
+    bool blank = true;
+    for (const char* q = p; q < trimmed; ++q) {
+      if (!std::isspace(static_cast<unsigned char>(*q))) { blank = false; break; }
+    }
+    if (!blank) lines.emplace_back(p, trimmed);
+    if (!nl) break;
+    p = nl + 1;
+  }
+  return lines;
+}
+
+char DetectSep(const char* b, const char* e, bool* is_libsvm) {
+  *is_libsvm = false;
+  bool first_token_done = false;
+  for (const char* p = b; p < e; ++p) {
+    if (*p == ':' && first_token_done) { *is_libsvm = true; return ' '; }
+    if (*p == '\t' || *p == ' ' || *p == ',') first_token_done = true;
+  }
+  for (const char* p = b; p < e; ++p) if (*p == '\t') return '\t';
+  for (const char* p = b; p < e; ++p) if (*p == ',') return ',';
+  return '\t';
+}
+
+void ParseDelimitedRow(const char* b, const char* e, char sep,
+                       std::vector<double>* out) {
+  const char* p = b;
+  while (p <= e) {
+    const char* q = p;
+    while (q < e && *q != sep) ++q;
+    size_t len = q - p;
+    if (IsNaToken(p, len)) {
+      out->push_back(std::numeric_limits<double>::quiet_NaN());
+    } else {
+      const char* fe;
+      out->push_back(FastAtof(p, &fe));
+    }
+    if (q >= e) break;
+    p = q + 1;
+  }
+}
+
+ParseResult* ParseBuffer(const char* buf, size_t len, int label_idx,
+                         int has_header) {
+  auto res = std::make_unique<ParseResult>();
+  auto lines = SplitLines(buf, len);
+  if (lines.empty()) {
+    res->error = "empty data file";
+    return res.release();
+  }
+  // scan up to 10 lines; stop at the first line with a definitive
+  // signal (a label-only row must not hide a LibSVM file; mirrors
+  // text_loader._detect_format)
+  bool is_libsvm = false;
+  char sep = '\t';
+  size_t probe_n = std::min<size_t>(lines.size(), 10);
+  for (size_t i = 0; i < probe_n; ++i) {
+    const char* b = lines[i].first;
+    const char* e = lines[i].second;
+    bool lsvm = false;
+    char s = DetectSep(b, e, &lsvm);
+    if (lsvm) { is_libsvm = true; break; }
+    bool has_sep = false;
+    for (const char* p = b; p < e; ++p) {
+      if (*p == '\t' || *p == ',') { has_sep = true; break; }
+    }
+    if (has_sep) { sep = s; break; }
+  }
+  size_t start = 0;
+  if (has_header && !is_libsvm) start = 1;
+  size_t n = lines.size() - start;
+  res->num_rows = static_cast<int64_t>(n);
+  res->label.assign(n, 0.0);
+
+  if (is_libsvm) {
+    // pass 1: max feature index (parallel)
+    std::vector<int32_t> maxf(NumThreads(), -1);
+    ParallelFor(n, [&](size_t t, size_t b, size_t e) {
+      int32_t mx = -1;
+      for (size_t i = b; i < e; ++i) {
+        const char* p = lines[start + i].first;
+        const char* le = lines[start + i].second;
+        while (p < le) {
+          const char* colon = static_cast<const char*>(
+              memchr(p, ':', le - p));
+          if (!colon) break;
+          const char* ks = colon;
+          while (ks > p && ks[-1] != ' ' && ks[-1] != '\t') --ks;
+          int32_t k = std::atoi(std::string(ks, colon - ks).c_str());
+          mx = std::max(mx, k);
+          p = colon + 1;
+        }
+      }
+      maxf[t] = std::max(maxf[t], mx);
+    });
+    int32_t f = 0;
+    for (int32_t m : maxf) f = std::max(f, m + 1);
+    res->num_cols = f;
+    res->data.assign(n * static_cast<size_t>(f), 0.0);
+    ParallelFor(n, [&](size_t, size_t b, size_t e) {
+      for (size_t i = b; i < e; ++i) {
+        const char* p = lines[start + i].first;
+        const char* le = lines[start + i].second;
+        const char* fe;
+        res->label[i] = FastAtof(p, &fe);
+        p = fe;
+        double* row = res->data.data() + i * static_cast<size_t>(f);
+        while (p < le) {
+          while (p < le && (*p == ' ' || *p == '\t')) ++p;
+          const char* colon = static_cast<const char*>(
+              memchr(p, ':', le - p));
+          if (!colon) break;
+          int32_t k = std::atoi(std::string(p, colon - p).c_str());
+          double v = FastAtof(colon + 1, &fe);
+          if (k >= 0 && k < f) row[k] = v;
+          p = fe;
+        }
+      }
+    });
+    return res.release();
+  }
+
+  // delimited: column count from first data row
+  std::vector<double> probe;
+  ParseDelimitedRow(lines[start].first, lines[start].second, sep, &probe);
+  int32_t total_cols = static_cast<int32_t>(probe.size());
+  if (label_idx < 0 || label_idx >= total_cols) {
+    res->error = "label_column out of range";
+    return res.release();
+  }
+  int32_t f = total_cols - 1;
+  res->num_cols = f;
+  res->data.assign(n * static_cast<size_t>(f), 0.0);
+  std::atomic<bool> bad_row{false};
+  ParallelFor(n, [&](size_t, size_t b, size_t e) {
+    std::vector<double> vals;
+    vals.reserve(total_cols);
+    for (size_t i = b; i < e; ++i) {
+      vals.clear();
+      ParseDelimitedRow(lines[start + i].first, lines[start + i].second,
+                        sep, &vals);
+      if (static_cast<int32_t>(vals.size()) != total_cols) {
+        bad_row = true;
+        continue;
+      }
+      res->label[i] = vals[label_idx];
+      double* row = res->data.data() + i * static_cast<size_t>(f);
+      int32_t c = 0;
+      for (int32_t j = 0; j < total_cols; ++j) {
+        if (j == label_idx) continue;
+        row[c++] = vals[j];
+      }
+    }
+  });
+  if (bad_row) res->error = "inconsistent column count across rows";
+  return res.release();
+}
+
+// ---------------------------------------------------------------------
+// Binning: GreedyFindBin + zero-as-one-bin composition, matching
+// binning.py bit for bit.
+// ---------------------------------------------------------------------
+
+void GreedyFindBin(const double* dv, const double* cnt, int64_t nd,
+                   int max_bin, int64_t total_cnt, int min_data_in_bin,
+                   std::vector<double>* bounds) {
+  const double kInf = std::numeric_limits<double>::infinity();
+  if (nd == 0) {
+    bounds->push_back(kInf);
+    return;
+  }
+  if (nd <= max_bin) {
+    double cur = 0;
+    for (int64_t i = 0; i < nd - 1; ++i) {
+      cur += cnt[i];
+      if (cur >= min_data_in_bin) {
+        bounds->push_back((dv[i] + dv[i + 1]) / 2.0);
+        cur = 0;
+      }
+    }
+    bounds->push_back(kInf);
+    return;
+  }
+  max_bin = std::max(1, max_bin);
+  double mean_bin_size = static_cast<double>(total_cnt) / max_bin;
+  std::vector<bool> is_big(nd);
+  double big_sum = 0;
+  int64_t n_big = 0;
+  for (int64_t i = 0; i < nd; ++i) {
+    is_big[i] = cnt[i] >= mean_bin_size;
+    if (is_big[i]) { big_sum += cnt[i]; ++n_big; }
+  }
+  int64_t rest_bins = max_bin - n_big;
+  if (rest_bins > 0) {
+    mean_bin_size = (total_cnt - big_sum) / static_cast<double>(rest_bins);
+  }
+  double bin_cnt = 0;
+  int64_t bins_left = max_bin;
+  for (int64_t i = 0; i < nd; ++i) {
+    bin_cnt += cnt[i];
+    bool next_big = (i + 1 < nd) ? is_big[i + 1] : false;
+    if (i == nd - 1) break;
+    if (is_big[i] || bin_cnt >= mean_bin_size ||
+        (next_big && bin_cnt >= std::max(1.0, mean_bin_size * 0.5))) {
+      if (bin_cnt >= min_data_in_bin || is_big[i]) {
+        bounds->push_back((dv[i] + dv[i + 1]) / 2.0);
+        bin_cnt = 0;
+        if (--bins_left <= 1) break;
+      }
+    }
+  }
+  bounds->push_back(kInf);
+}
+
+}  // namespace
+
+extern "C" {
+
+// ------------------------------ parsing ------------------------------
+
+void* LGT_ParseFile(const char* path, int label_idx, int has_header) {
+  FILE* fp = std::fopen(path, "rb");
+  if (!fp) {
+    auto* res = new ParseResult();
+    res->error = std::string("cannot open file: ") + path;
+    return res;
+  }
+  std::fseek(fp, 0, SEEK_END);
+  long sz = std::ftell(fp);
+  std::fseek(fp, 0, SEEK_SET);
+  std::vector<char> buf(static_cast<size_t>(sz) + 1);
+  size_t rd = std::fread(buf.data(), 1, sz, fp);
+  std::fclose(fp);
+  buf[rd] = 0;
+  return ParseBuffer(buf.data(), rd, label_idx, has_header);
+}
+
+int64_t LGT_ParseNumRows(void* h) {
+  return static_cast<ParseResult*>(h)->num_rows;
+}
+int32_t LGT_ParseNumCols(void* h) {
+  return static_cast<ParseResult*>(h)->num_cols;
+}
+const char* LGT_ParseError(void* h) {
+  ParseResult* r = static_cast<ParseResult*>(h);
+  return r->error.empty() ? nullptr : r->error.c_str();
+}
+void LGT_ParseCopy(void* h, double* data_out, double* label_out) {
+  ParseResult* r = static_cast<ParseResult*>(h);
+  std::memcpy(data_out, r->data.data(), r->data.size() * sizeof(double));
+  std::memcpy(label_out, r->label.data(), r->label.size() * sizeof(double));
+}
+void LGT_ParseFree(void* h) { delete static_cast<ParseResult*>(h); }
+
+// ------------------------------ binning ------------------------------
+
+// Numerical bounds with zero-as-one-bin (ref: bin.cpp:247). `values` may
+// contain NaN. Returns the number of bounds written to `bounds_out`
+// (capacity must be >= max_bin + 2), or -1 on error.
+int32_t LGT_FindNumericalBounds(const double* values, int64_t n,
+                                int max_bin, int min_data_in_bin,
+                                int missing_type, int zero_as_missing,
+                                double* bounds_out) {
+  const double kInf = std::numeric_limits<double>::infinity();
+  std::vector<double> clean;
+  clean.reserve(n);
+  for (int64_t i = 0; i < n; ++i) {
+    double v = values[i];
+    if (std::isnan(v)) continue;
+    if (zero_as_missing && std::fabs(v) <= kZeroThreshold) continue;
+    clean.push_back(v);
+  }
+  if (clean.empty()) {
+    bounds_out[0] = kInf;
+    return 1;
+  }
+  std::sort(clean.begin(), clean.end());
+  // distinct + counts
+  std::vector<double> dv;
+  std::vector<double> cnt;
+  dv.reserve(clean.size());
+  for (double v : clean) {
+    if (dv.empty() || v != dv.back()) {
+      dv.push_back(v);
+      cnt.push_back(1);
+    } else {
+      cnt.back() += 1;
+    }
+  }
+  int64_t nd = static_cast<int64_t>(dv.size());
+
+  int64_t n_neg = 0, n_pos = 0;
+  for (double v : dv) {
+    if (v < -kZeroThreshold) ++n_neg;
+    else if (v > kZeroThreshold) ++n_pos;
+  }
+  int64_t zero_distincts = nd - n_neg - n_pos;
+  double neg_cnt = 0, pos_cnt = 0, zero_cnt = 0;
+  for (int64_t i = 0; i < nd; ++i) {
+    if (dv[i] < -kZeroThreshold) neg_cnt += cnt[i];
+    else if (dv[i] > kZeroThreshold) pos_cnt += cnt[i];
+    else zero_cnt += cnt[i];
+  }
+
+  int avail = (missing_type == kMissingNan)
+      ? std::max(max_bin - 1, 1) : max_bin;
+  // share bins between halves proportional to distinct counts
+  // (mirror of binning.py: round-half-even via nearbyint to match
+  // Python round())
+  double ratio = static_cast<double>(n_neg) /
+      std::max<int64_t>(n_neg + n_pos, 1);
+  int left_max = static_cast<int>(std::nearbyint(avail * ratio));
+  left_max = std::min(std::max(left_max, n_neg ? 1 : 0),
+                      avail - (n_pos ? 1 : 0));
+  int right_max = avail - left_max - 1;
+
+  std::vector<double> bounds;
+  if (n_neg) {
+    std::vector<double> lb;
+    GreedyFindBin(dv.data(), cnt.data(), n_neg, std::max(left_max, 1),
+                  static_cast<int64_t>(neg_cnt), min_data_in_bin, &lb);
+    for (size_t i = 0; i + 1 < lb.size(); ++i) bounds.push_back(lb[i]);
+    bounds.push_back(-kZeroThreshold);
+  }
+  if (n_pos) {
+    bounds.push_back(kZeroThreshold);
+    std::vector<double> rb;
+    int64_t pos_start = nd - n_pos;
+    GreedyFindBin(dv.data() + pos_start, cnt.data() + pos_start, n_pos,
+                  std::max(right_max, 1), static_cast<int64_t>(pos_cnt),
+                  min_data_in_bin, &rb);
+    for (size_t i = 0; i + 1 < rb.size(); ++i) bounds.push_back(rb[i]);
+  } else if (zero_cnt > 0 || n_neg) {
+    bounds.push_back(kZeroThreshold);
+  }
+  bounds.push_back(kInf);
+  std::sort(bounds.begin(), bounds.end());
+  bounds.erase(std::unique(bounds.begin(), bounds.end()), bounds.end());
+  (void)zero_distincts;
+
+  int32_t nb = static_cast<int32_t>(bounds.size());
+  if (nb > max_bin + 2) nb = max_bin + 2;
+  std::memcpy(bounds_out, bounds.data(), nb * sizeof(double));
+  return nb;
+}
+
+// value -> bin over one column (multithreaded searchsorted; ref:
+// BinMapper::ValueToBin). bins_out is int32 [n].
+void LGT_TransformColumn(const double* values, int64_t n,
+                         const double* bounds, int32_t num_bounds,
+                         int missing_type, int32_t default_bin,
+                         int32_t num_bins, int32_t* bins_out) {
+  ParallelFor(static_cast<size_t>(n), [&](size_t, size_t b, size_t e) {
+    for (size_t i = b; i < e; ++i) {
+      double v = values[i];
+      bool isnan = std::isnan(v);
+      if (missing_type == kMissingZero && isnan) {
+        v = 0.0;
+        isnan = false;
+      }
+      int32_t bin;
+      if (isnan) {
+        bin = (missing_type == kMissingNan) ? num_bins - 1 : default_bin;
+      } else {
+        // lower_bound == np.searchsorted(side="left")
+        const double* it = std::lower_bound(bounds, bounds + num_bounds, v);
+        bin = static_cast<int32_t>(it - bounds);
+        if (bin > num_bounds - 1) bin = num_bounds - 1;
+      }
+      bins_out[i] = bin;
+    }
+  });
+}
+
+// Bin a whole [n, f] column-major slab of raw features into uint8/uint16
+// feature-major bins, threaded over features. `bounds_flat` concatenates
+// per-feature bounds with `bounds_offsets` (f+1 entries).
+void LGT_TransformMatrix(const double* data_cm, int64_t n, int32_t f,
+                         const double* bounds_flat,
+                         const int64_t* bounds_offsets,
+                         const int32_t* missing_types,
+                         const int32_t* default_bins,
+                         const int32_t* num_bins, int elem_size,
+                         void* bins_out_fm) {
+  ParallelFor(static_cast<size_t>(f), [&](size_t, size_t b, size_t e) {
+    std::vector<int32_t> tmp(n);
+    for (size_t j = b; j < e; ++j) {
+      const double* col = data_cm + j * n;
+      const double* bounds = bounds_flat + bounds_offsets[j];
+      int32_t nb = static_cast<int32_t>(bounds_offsets[j + 1] -
+                                        bounds_offsets[j]);
+      // inline single-threaded transform (outer loop already parallel)
+      for (int64_t i = 0; i < n; ++i) {
+        double v = col[i];
+        bool isnan = std::isnan(v);
+        if (missing_types[j] == kMissingZero && isnan) {
+          v = 0.0;
+          isnan = false;
+        }
+        int32_t bin;
+        if (isnan) {
+          bin = (missing_types[j] == kMissingNan) ? num_bins[j] - 1
+                                                  : default_bins[j];
+        } else {
+          const double* it = std::lower_bound(bounds, bounds + nb, v);
+          bin = static_cast<int32_t>(it - bounds);
+          if (bin > nb - 1) bin = nb - 1;
+        }
+        tmp[i] = bin;
+      }
+      if (elem_size == 1) {
+        uint8_t* out = static_cast<uint8_t*>(bins_out_fm) + j * n;
+        for (int64_t i = 0; i < n; ++i) out[i] = static_cast<uint8_t>(tmp[i]);
+      } else {
+        uint16_t* out = static_cast<uint16_t*>(bins_out_fm) + j * n;
+        for (int64_t i = 0; i < n; ++i) out[i] = static_cast<uint16_t>(tmp[i]);
+      }
+    }
+  });
+}
+
+int32_t LGT_Version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,155 @@
+"""Native C++ host runtime tests: parser + binning parity with the
+NumPy fallback (the two paths must agree bit-for-bit)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import native
+from lightgbm_tpu.binning import BinMapper
+from lightgbm_tpu.io.text_loader import load_svmlight_or_csv
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+@pytest.fixture
+def columns(rng):
+    return {
+        "normal": rng.randn(5000),
+        "zero_heavy": np.concatenate([np.zeros(2000),
+                                      rng.gamma(2, 2, 3000)]),
+        "with_nan": np.concatenate([rng.randn(3000), [np.nan] * 200]),
+        "few_distinct": np.round(rng.randn(8000) * 2),
+        "constant": np.full(500, 3.25),
+        "negative": -np.abs(rng.randn(4000)),
+    }
+
+
+def test_find_bounds_parity(columns):
+    for name, vals in columns.items():
+        for zam in (False, True):
+            for max_bin in (15, 63, 255):
+                m = BinMapper()
+                # force the python path by disabling native inside fit
+                os.environ["LIGHTGBM_TPU_NO_NATIVE"] = "1"
+                try:
+                    native_state = native._tried, native._lib
+                    native._tried, native._lib = True, None
+                    m.fit(vals.copy(), max_bin=max_bin, min_data_in_bin=3,
+                          zero_as_missing=zam)
+                finally:
+                    del os.environ["LIGHTGBM_TPU_NO_NATIVE"]
+                    native._tried, native._lib = native_state
+                nb = native.find_numerical_bounds(
+                    vals, max_bin, 3, m.missing_type, zam)
+                assert nb is not None
+                np.testing.assert_array_equal(
+                    nb, m.bin_upper_bound,
+                    err_msg=f"bounds mismatch: {name} zam={zam} "
+                            f"max_bin={max_bin}")
+
+
+def test_transform_parity(columns):
+    for name, vals in columns.items():
+        m = BinMapper().fit(vals.copy(), max_bin=63, min_data_in_bin=3)
+        py = np.searchsorted(m.bin_upper_bound,
+                             np.where(np.isnan(vals), 0.0, vals),
+                             side="left")
+        nat = native.transform_column(vals, m.bin_upper_bound,
+                                      m.missing_type, m.default_bin,
+                                      m.num_bins)
+        ref = m.transform(vals)  # may itself use native for big arrays
+        np.testing.assert_array_equal(nat, ref, err_msg=name)
+
+
+def test_transform_matrix_parity(rng):
+    data = rng.randn(3000, 12)
+    data[rng.rand(3000, 12) < 0.05] = np.nan
+    mappers = [BinMapper().fit(data[:, j], max_bin=63) for j in range(12)]
+    out = native.transform_matrix(np.ascontiguousarray(data), mappers,
+                                  np.uint8)
+    assert out is not None
+    for j, m in enumerate(mappers):
+        np.testing.assert_array_equal(out[j], m.transform(data[:, j]),
+                                      err_msg=f"col {j}")
+
+
+def test_parse_tsv_parity(tmp_path, rng):
+    X = rng.randn(300, 5)
+    y = (X[:, 0] > 0).astype(float)
+    path = tmp_path / "d.tsv"
+    with open(path, "w") as fh:
+        for label, row in zip(y, X):
+            fh.write("\t".join([f"{label:g}"] + [f"{v:.8g}" for v in row])
+                     + "\n")
+    data, label = native.parse_file(str(path), 0, False)
+    assert data.shape == (300, 5)
+    np.testing.assert_allclose(label, y)
+    np.testing.assert_allclose(data, X, rtol=1e-6)
+
+
+def test_parse_csv_with_missing(tmp_path):
+    path = tmp_path / "d.csv"
+    path.write_text("1,0.5,NA,2.0\n0,nan,1.5,\n1,3.0,?,4.0\n")
+    data, label = native.parse_file(str(path), 0, False)
+    np.testing.assert_allclose(label, [1, 0, 1])
+    assert np.isnan(data[0, 1]) and np.isnan(data[1, 0])
+    assert np.isnan(data[1, 2]) and np.isnan(data[2, 1])
+    np.testing.assert_allclose(data[2], [3.0, np.nan, 4.0])
+
+
+def test_parse_libsvm(tmp_path):
+    path = tmp_path / "d.svm"
+    path.write_text("1 0:0.5 3:2.0\n0 1:1.5\n1 0:3.0 2:1.0 3:4.0\n")
+    data, label = native.parse_file(str(path), 0, False)
+    assert data.shape == (3, 4)
+    np.testing.assert_allclose(label, [1, 0, 1])
+    np.testing.assert_allclose(data[0], [0.5, 0, 0, 2.0])
+    np.testing.assert_allclose(data[1], [0, 1.5, 0, 0])
+
+
+def test_parse_header_and_label_column(tmp_path):
+    path = tmp_path / "d.csv"
+    path.write_text("a,b,target\n0.1,0.2,1\n0.3,0.4,0\n")
+    data, label = native.parse_file(str(path), 2, True)
+    np.testing.assert_allclose(label, [1, 0])
+    np.testing.assert_allclose(data, [[0.1, 0.2], [0.3, 0.4]])
+
+
+def test_loader_uses_native_and_matches_python(tmp_path, rng):
+    X = rng.randn(500, 4)
+    y = (X[:, 0] > 0).astype(float)
+    path = tmp_path / "d.tsv"
+    with open(path, "w") as fh:
+        for label, row in zip(y, X):
+            fh.write("\t".join([f"{label:g}"] + [f"{v:.8g}" for v in row])
+                     + "\n")
+    d1, l1, _, _ = load_svmlight_or_csv(str(path), {})
+    native_state = native._tried, native._lib
+    try:
+        native._tried, native._lib = True, None
+        d2, l2, _, _ = load_svmlight_or_csv(str(path), {})
+    finally:
+        native._tried, native._lib = native_state
+    np.testing.assert_allclose(d1, d2)
+    np.testing.assert_allclose(l1, l2)
+
+
+def test_parse_error_path(tmp_path):
+    path = tmp_path / "bad.csv"
+    path.write_text("1,2,3\n4,5\n")
+    with pytest.raises(ValueError):
+        native.parse_file(str(path), 0, False)
+
+
+def test_end_to_end_training_with_native(rng):
+    import lightgbm_tpu as lgb
+    X = rng.randn(2000, 10)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.5).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "num_leaves": 15}, lgb.Dataset(X, label=y),
+                    num_boost_round=10)
+    preds = bst.predict(X)
+    assert preds[y == 1].mean() > preds[y == 0].mean() + 0.2
