@@ -35,6 +35,18 @@ from .tree import Tree
 K_EPSILON = 1e-35
 
 
+def _multi_value(value):
+    """Multi-value param -> list of floats, accepting both the Python list
+    form and the reference's comma-separated string form
+    (ref: config.h multi-value params like monotone_constraints)."""
+    if value is None:
+        return None
+    if isinstance(value, str):
+        value = [v for v in value.split(",") if v.strip()]
+    vals = [float(v) for v in value]
+    return vals if vals else None
+
+
 def _tree_record_to_host(record) -> Dict[str, np.ndarray]:
     return {k: np.asarray(v) for k, v in record._asdict().items()}
 
@@ -72,16 +84,38 @@ class GBDT:
             train_set.feature_meta_arrays()
         mono = np.zeros(train_set.num_features, np.int8)
         if config.monotone_constraints is not None:
-            mc = np.asarray(config.monotone_constraints, np.int8)
+            mc = np.asarray(_multi_value(config.monotone_constraints),
+                            np.int8)
             for j, col in enumerate(train_set.used_features):
                 if col < len(mc):
                     mono[j] = mc[col]
         penalty = np.ones(train_set.num_features, np.float32)
         if config.feature_contri is not None:
-            fc = np.asarray(config.feature_contri, np.float32)
+            fc = np.asarray(_multi_value(config.feature_contri), np.float32)
             for j, col in enumerate(train_set.used_features):
                 if col < len(fc):
                     penalty[j] = fc[col]
+
+        # CEGB per-feature penalties (ref: cost_effective_gradient_boosting
+        # .hpp DeltaGain). Coupled penalties are charged on a feature's
+        # first use in the model; the used-set is refreshed between
+        # iterations (the reference updates mid-tree). Lazy penalties are
+        # charged per row in the leaf (upper bound of the reference's
+        # per-(row, feature) first-query tracking).
+        def _per_feature(cfg_list):
+            out = np.zeros(train_set.num_features, np.float32)
+            vals = _multi_value(cfg_list)
+            if vals is not None:
+                arr = np.asarray(vals, np.float32)
+                for j, col in enumerate(train_set.used_features):
+                    if col < len(arr):
+                        out[j] = arr[col]
+            return out
+        self._cegb_coupled = _per_feature(config.cegb_penalty_feature_coupled)
+        self._cegb_lazy = _per_feature(config.cegb_penalty_feature_lazy)
+        self._cegb_used = np.zeros(train_set.num_features, bool)
+        self._has_cegb_coupled = bool(np.any(self._cegb_coupled != 0))
+
         self.feature_meta = FeatureMeta(
             num_bins=jnp.asarray(num_bins),
             missing_type=jnp.asarray(missing),
@@ -89,6 +123,9 @@ class GBDT:
             is_categorical=jnp.asarray(is_cat),
             monotone=jnp.asarray(mono),
             penalty=jnp.asarray(penalty),
+            cegb_feat=jnp.asarray(
+                config.cegb_tradeoff * self._cegb_coupled),
+            cegb_lazy=jnp.asarray(config.cegb_tradeoff * self._cegb_lazy),
         )
         self.hp = SplitHyperParams.from_config(config)
         self.max_depth = jnp.asarray(config.max_depth, jnp.int32)
@@ -96,6 +133,8 @@ class GBDT:
             num_leaves=int(config.num_leaves),
             max_bins=int(train_set.max_bins),
         )
+        self._forced = self._parse_forced_splits()
+        self._interaction_groups = self._parse_interaction_constraints()
 
         # scores [K, N] on device (ScoreUpdater analog, score_updater.hpp:22)
         scores = np.zeros((self.num_tree_per_iteration, self.num_data),
@@ -127,11 +166,74 @@ class GBDT:
         self._valid_sets: List = []
         self._valid_scores: List[np.ndarray] = []
 
+    def _parse_forced_splits(self):
+        """forcedsplits_filename JSON -> (leaf, feature, threshold_bin)
+        int32 arrays aligned with scan steps, or None
+        (ref: serial_tree_learner.cpp:628 ForceSplits; the JSON tree is
+        walked breadth-first, left child keeps the parent's leaf id,
+        right child becomes leaf step+1 — the learner's numbering)."""
+        fname = self.config.forcedsplits_filename
+        if not fname:
+            return None
+        import json as _json
+        with open(fname) as fh:
+            spec = _json.load(fh)
+        if not spec:
+            return None
+        L = self._static["num_leaves"]
+        ts = self.train_set
+        used_map = {c: j for j, c in enumerate(ts.used_features)}
+        leaf_arr = np.full(L - 1, -1, np.int32)
+        feat_arr = np.full(L - 1, -1, np.int32)
+        thr_arr = np.full(L - 1, -1, np.int32)
+        queue = [(0, spec)]
+        s = 0
+        while queue and s < L - 1:
+            leaf, node = queue.pop(0)
+            raw_f = int(node["feature"])
+            if raw_f not in used_map:
+                continue  # feature dropped as trivial — skip this subtree
+            j = used_map[raw_f]
+            tbin = int(self.train_set.mappers[j].transform(
+                np.asarray([float(node["threshold"])]))[0])
+            leaf_arr[s], feat_arr[s], thr_arr[s] = leaf, j, tbin
+            if "left" in node and node["left"]:
+                queue.append((leaf, node["left"]))
+            if "right" in node and node["right"]:
+                queue.append((s + 1, node["right"]))
+            s += 1
+        if s == 0:
+            return None
+        return (jnp.asarray(leaf_arr), jnp.asarray(feat_arr),
+                jnp.asarray(thr_arr))
+
+    def _parse_interaction_constraints(self):
+        """interaction_constraints -> [G, F_used] bool array or None
+        (ref: config.h interaction_constraints; col_sampler.hpp)."""
+        ic = self.config.interaction_constraints
+        if not ic:
+            return None
+        if isinstance(ic, str):
+            import json as _json
+            ic = _json.loads(f"[{ic}]" if not ic.startswith("[[") else ic)
+        groups = [list(map(int, g)) for g in ic]
+        if not groups:
+            return None
+        ts = self.train_set
+        used_map = {c: j for j, c in enumerate(ts.used_features)}
+        out = np.zeros((len(groups), ts.num_features), bool)
+        for gi, g in enumerate(groups):
+            for raw_f in g:
+                if raw_f in used_map:
+                    out[gi, used_map[raw_f]] = True
+        return out
+
     def _build_grow(self, hist_impl: str) -> None:
         self._hist_impl = hist_impl
         self._grow = jax.jit(functools.partial(
             grow_tree, **self._static, hist_dtype=jnp.float32,
-            hist_impl=hist_impl))
+            hist_impl=hist_impl,
+            interaction_groups=self._interaction_groups))
         self._fused = None
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
@@ -153,6 +255,10 @@ class GBDT:
         if custom_grad is not None or self.objective is None:
             return False
         if self.boosting_type != "gbdt":
+            return False
+        if self._has_cegb_coupled:
+            # coupled penalties change per iteration with the used-feature
+            # set; needs the host loop
             return False
         # objectives that renew leaf outputs need per-iteration host work
         renews = type(self.objective).renew_tree_output is not \
@@ -220,7 +326,8 @@ class GBDT:
         num_valid = len(self._valid_bins)
         grow = functools.partial(grow_tree, **self._static,
                                  hist_dtype=jnp.float32,
-                                 hist_impl=self._hist_impl)
+                                 hist_impl=self._hist_impl,
+                                 interaction_groups=self._interaction_groups)
         goss = self.config.data_sample_strategy == "goss"
 
         def fused(scores, sample_mask, valid_scores, it, lr):
@@ -241,7 +348,7 @@ class GBDT:
                     jax.random.fold_in(key, 200 + k))
                 rec, row_leaf = grow(self.bins_fm, grad, hess, mask, fmask,
                                      self.feature_meta, self.hp,
-                                     self.max_depth)
+                                     self.max_depth, self._forced)
                 # 1-leaf trees contribute nothing (the reference stops
                 # training instead, gbdt.cpp should_continue)
                 leaf_vals = jnp.where(rec.num_leaves > 1,
@@ -420,7 +527,7 @@ class GBDT:
 
             record, row_leaf = self._grow(
                 self.bins_fm, grad, hess, mask, feature_mask,
-                self.feature_meta, self.hp, self.max_depth)
+                self.feature_meta, self.hp, self.max_depth, self._forced)
 
             rec_host = _tree_record_to_host(record)
             tree = Tree.from_arrays(rec_host, self.train_set.mappers,
@@ -453,6 +560,20 @@ class GBDT:
         if not should_continue:
             self.models.pop()
             return True
+        if self._has_cegb_coupled:
+            # refresh first-use coupled penalties
+            # (ref: UpdateLeafBestSplits marks is_feature_used_in_split_)
+            changed = False
+            for tree in iter_trees:
+                for f_inner in tree.split_feature_inner[:tree.num_internal]:
+                    if not self._cegb_used[f_inner]:
+                        self._cegb_used[f_inner] = True
+                        changed = True
+            if changed:
+                new_pen = self.config.cegb_tradeoff * np.where(
+                    self._cegb_used, 0.0, self._cegb_coupled)
+                self.feature_meta = self.feature_meta._replace(
+                    cegb_feat=jnp.asarray(new_pen.astype(np.float32)))
         self.iter += 1
         return False
 

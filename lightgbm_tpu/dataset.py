@@ -31,8 +31,10 @@ def _transform_all(data: np.ndarray, mappers: List[BinMapper],
     done = set()
     if len(numeric) > 1 and n * len(numeric) >= 65536:
         from . import native as _native
-        sub = np.ascontiguousarray(
-            data[:, [used[j] for j in numeric]], np.float64)
+        # single Fortran-order materialization (the C++ kernel reads
+        # column-major)
+        sub = np.asfortranarray(data[:, [used[j] for j in numeric]],
+                                np.float64)
         out = _native.transform_matrix(sub, [mappers[j] for j in numeric],
                                        dtype)
         if out is not None:

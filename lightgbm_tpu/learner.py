@@ -29,7 +29,8 @@ from .ops import partition as part_ops
 from .ops import split as split_ops
 from .ops.histogram import COUNT, GRAD, HESS
 from .ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams, SplitInfo,
-                        find_best_split, leaf_output)
+                        find_best_split, leaf_gain_given_output, leaf_output,
+                        leaf_output_smooth)
 
 
 class TreeArrays(NamedTuple):
@@ -61,6 +62,7 @@ class _LeafSplits(NamedTuple):
     sum_hess: jax.Array   # [L]
     count: jax.Array      # [L]
     depth: jax.Array      # [L] int32
+    output: jax.Array     # [L] (path-smoothed) leaf output
     gain: jax.Array       # [L]
     feature: jax.Array    # [L] int32
     threshold: jax.Array  # [L] int32
@@ -74,9 +76,10 @@ class _GrowState(NamedTuple):
     row_leaf: jax.Array   # [N] int32
     pool: jax.Array       # [L, F, B, 3] histogram pool
     leaves: _LeafSplits
+    used_features: Optional[jax.Array]  # [L, F] bool (interaction constraints)
 
 
-def _store_split(leaves: _LeafSplits, idx, info: SplitInfo, depth,
+def _store_split(leaves: _LeafSplits, idx, info: SplitInfo, depth, output,
                  sum_grad, sum_hess, count, valid) -> _LeafSplits:
     """Write one leaf's stats + its best candidate split at slot `idx`."""
     def upd(arr, val):
@@ -86,6 +89,7 @@ def _store_split(leaves: _LeafSplits, idx, info: SplitInfo, depth,
         sum_hess=upd(leaves.sum_hess, sum_hess),
         count=upd(leaves.count, count),
         depth=upd(leaves.depth, depth),
+        output=upd(leaves.output, output),
         gain=upd(leaves.gain, info.gain),
         feature=upd(leaves.feature, info.feature),
         threshold=upd(leaves.threshold, info.threshold),
@@ -96,6 +100,15 @@ def _store_split(leaves: _LeafSplits, idx, info: SplitInfo, depth,
     )
 
 
+def _allowed_features(used_row: jax.Array, groups: jax.Array) -> jax.Array:
+    """Features usable below a node given the features already used on its
+    path (ref: col_sampler.hpp interaction-constraint filtering): the
+    union of constraint groups that contain every used feature."""
+    # group g qualifies iff used_row is a subset of groups[g]
+    qualifies = ~jnp.any(used_row[None, :] & ~groups, axis=1)  # [G]
+    return jnp.any(groups & qualifies[:, None], axis=0)  # [F]
+
+
 def grow_tree(bins_fm: jax.Array,
               grad: jax.Array,
               hess: jax.Array,
@@ -104,17 +117,24 @@ def grow_tree(bins_fm: jax.Array,
               meta: FeatureMeta,
               hp: SplitHyperParams,
               max_depth: jax.Array,
+              forced: Optional[tuple] = None,
               *,
               num_leaves: int,
               max_bins: int,
               hist_dtype=jnp.float32,
               row_chunk: int = 0,
-              hist_impl: str = "xla"):
+              hist_impl: str = "xla",
+              interaction_groups=None):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
 
     sample_mask: [N] float {0,1} bagging/GOSS selection (excluded rows still
     get a leaf assignment for score updates, but contribute no statistics —
     ref: bagging keeps full score updates, gbdt.cpp:502).
+    forced: optional (leaf [L-1], feature [L-1], threshold_bin [L-1]) int32
+    arrays; entries >= 0 force that split at that scan step
+    (ref: serial_tree_learner.cpp:628 ForceSplits).
+    interaction_groups: optional [G, F] bool array of allowed feature
+    combinations (ref: config.h interaction_constraints).
     """
     num_data = bins_fm.shape[1]
     num_features = bins_fm.shape[0]
@@ -124,25 +144,35 @@ def grow_tree(bins_fm: jax.Array,
     build = functools.partial(hist_ops.build_histogram, max_bins=max_bins,
                               dtype=f32, row_chunk=row_chunk, impl=hist_impl)
 
+    if interaction_groups is not None:
+        interaction_groups = jnp.asarray(interaction_groups, bool)
+        root_allowed = jnp.any(interaction_groups, axis=0)
+    else:
+        root_allowed = None
+
     # --- root (ref: serial_tree_learner.cpp BeforeTrain root LeafSplits init)
     root_hist = build(bins_fm, grad, hess, sample_mask)
     root_g = jnp.sum(grad * sample_mask, dtype=f32)
     root_h = jnp.sum(hess * sample_mask, dtype=f32)
     root_c = jnp.sum(sample_mask, dtype=f32)
+    root_out = leaf_output(root_g, root_h, hp)
+    root_fmask = feature_mask if root_allowed is None else \
+        feature_mask & root_allowed
     root_split = find_best_split(root_hist, root_g, root_h, root_c,
-                                 meta, hp, feature_mask)
+                                 meta, hp, root_fmask, root_out)
 
     zero_l = jnp.zeros((L,), f32)
     leaves = _LeafSplits(
         sum_grad=zero_l, sum_hess=zero_l, count=zero_l,
         depth=jnp.zeros((L,), jnp.int32),
+        output=zero_l,
         gain=jnp.full((L,), K_MIN_SCORE, f32),
         feature=jnp.zeros((L,), jnp.int32),
         threshold=jnp.zeros((L,), jnp.int32),
         default_left=jnp.zeros((L,), jnp.bool_),
         left_sum_grad=zero_l, left_sum_hess=zero_l, left_count=zero_l,
     )
-    leaves = _store_split(leaves, 0, root_split, jnp.int32(1),
+    leaves = _store_split(leaves, 0, root_split, jnp.int32(1), root_out,
                           root_g, root_h, root_c, True)
 
     pool = jnp.zeros((L, num_features, max_bins, hist_ops.NUM_HIST_CHANNELS),
@@ -153,30 +183,58 @@ def grow_tree(bins_fm: jax.Array,
         row_leaf=jnp.zeros((num_data,), jnp.int32),
         pool=pool,
         leaves=leaves,
+        used_features=(jnp.zeros((L, num_features), bool)
+                       if interaction_groups is not None else None),
     )
+
+    if forced is None:
+        neg1 = jnp.full((L - 1,), -1, jnp.int32)
+        forced = (neg1, neg1, neg1)
+    forced_leaf_arr, forced_feat_arr, forced_thr_arr = forced
 
     def step(state: _GrowState, step_idx):
         leaves = state.leaves
-        best_leaf = jnp.argmax(leaves.gain).astype(jnp.int32)
-        valid = leaves.gain[best_leaf] > 0.0
+        f_leaf = forced_leaf_arr[step_idx]
+        use_forced = f_leaf >= 0
+        best_leaf = jnp.where(use_forced, f_leaf,
+                              jnp.argmax(leaves.gain).astype(jnp.int32))
         new_leaf = (step_idx + 1).astype(jnp.int32)
 
-        feat = leaves.feature[best_leaf]
-        thr = leaves.threshold[best_leaf]
-        dleft = leaves.default_left[best_leaf]
+        feat = jnp.where(use_forced, forced_feat_arr[step_idx],
+                         leaves.feature[best_leaf])
+        thr = jnp.where(use_forced, forced_thr_arr[step_idx],
+                        leaves.threshold[best_leaf])
+        # forced splits route missing by the zero-bin rule
+        # (ref: ForceSplits computes the split like any other candidate)
+        forced_dleft = (meta.missing_type[feat] == split_ops.MISSING_ZERO) \
+            & (meta.default_bin[feat] <= thr)
+        dleft = jnp.where(use_forced, forced_dleft,
+                          leaves.default_left[best_leaf])
+
+        # --- children stats: stored candidate, or recomputed from the
+        # parent histogram for a forced (feature, threshold)
+        pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
+                      leaves.count[best_leaf])
+        parent_hist_pre = state.pool[best_leaf]
+        bin_le = (jnp.arange(parent_hist_pre.shape[1]) <= thr)
+        forced_left = jnp.sum(parent_hist_pre[feat] * bin_le[:, None], axis=0)
+        lg = jnp.where(use_forced, forced_left[GRAD],
+                       leaves.left_sum_grad[best_leaf])
+        lh = jnp.where(use_forced, forced_left[HESS],
+                       leaves.left_sum_hess[best_leaf])
+        lc = jnp.where(use_forced, forced_left[COUNT],
+                       leaves.left_count[best_leaf])
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+
+        # a forced split must leave data on both sides; a best split must
+        # have positive gain
+        valid = jnp.where(use_forced, (lc > 0) & (rc > 0),
+                          leaves.gain[best_leaf] > 0.0)
 
         # --- partition rows (left keeps best_leaf id, right -> new_leaf)
         row_leaf = part_ops.apply_split(
             state.row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
             meta.num_bins, meta.missing_type, meta.is_categorical, valid)
-
-        # --- children stats from the stored candidate
-        lg = leaves.left_sum_grad[best_leaf]
-        lh = leaves.left_sum_hess[best_leaf]
-        lc = leaves.left_count[best_leaf]
-        pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
-                      leaves.count[best_leaf])
-        rg, rh, rc = pg - lg, ph - lh, pc - lc
 
         # --- histograms: build smaller child, subtract for the sibling
         # (ref: serial_tree_learner.cpp:373-386,582)
@@ -194,11 +252,30 @@ def grow_tree(bins_fm: jax.Array,
         pool = pool.at[new_leaf].set(
             jnp.where(valid, right_hist, pool[new_leaf]))
 
+        # --- child outputs (path-smoothed toward the parent's output)
+        parent_out = leaves.output[best_leaf]
+        out_l = leaf_output_smooth(lg, lh, lc, parent_out, hp)
+        out_r = leaf_output_smooth(rg, rh, rc, parent_out, hp)
+
+        # --- per-child allowed features (interaction constraints)
+        used_features = state.used_features
+        if used_features is not None:
+            child_used = used_features[best_leaf].at[feat].set(True)
+            used_features = used_features.at[best_leaf].set(
+                jnp.where(valid, child_used, used_features[best_leaf]))
+            used_features = used_features.at[new_leaf].set(
+                jnp.where(valid, child_used, used_features[new_leaf]))
+            child_fmask = feature_mask & _allowed_features(
+                child_used, interaction_groups)
+        else:
+            child_fmask = feature_mask
+
         # --- find child best splits
         child_depth = leaves.depth[best_leaf] + 1
-        split_l = find_best_split(left_hist, lg, lh, lc, meta, hp, feature_mask)
+        split_l = find_best_split(left_hist, lg, lh, lc, meta, hp,
+                                  child_fmask, out_l)
         split_r = find_best_split(right_hist, rg, rh, rc, meta, hp,
-                                  feature_mask)
+                                  child_fmask, out_r)
         # depth cap (ref: serial_tree_learner.cpp max_depth check)
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         split_l = split_l._replace(
@@ -206,9 +283,17 @@ def grow_tree(bins_fm: jax.Array,
         split_r = split_r._replace(
             gain=jnp.where(depth_ok, split_r.gain, K_MIN_SCORE))
 
-        leaves = _store_split(leaves, best_leaf, split_l, child_depth,
+        # the parent's chosen gain, before leaves is overwritten (for a
+        # forced split: the actual gain of the forced threshold)
+        forced_gain = (leaf_gain_given_output(lg, lh, out_l, hp)
+                       + leaf_gain_given_output(rg, rh, out_r, hp)
+                       - leaf_gain_given_output(pg, ph, parent_out, hp))
+        chosen_gain = jnp.where(use_forced, forced_gain,
+                                leaves.gain[best_leaf])
+
+        leaves = _store_split(leaves, best_leaf, split_l, child_depth, out_l,
                               lg, lh, lc, valid)
-        leaves = _store_split(leaves, new_leaf, split_r, child_depth,
+        leaves = _store_split(leaves, new_leaf, split_r, child_depth, out_r,
                               rg, rh, rc, valid)
 
         record = dict(
@@ -216,16 +301,12 @@ def grow_tree(bins_fm: jax.Array,
             split_feature=feat,
             split_bin_threshold=thr,
             split_default_left=dleft,
-            split_gain=jnp.where(valid, leaves.gain[best_leaf], 0.0),
-            internal_value=leaf_output(pg, ph, hp),
+            split_gain=jnp.where(valid, chosen_gain, 0.0),
+            internal_value=parent_out,
             internal_weight=ph,
             internal_count=pc,
         )
-        # note: split_gain above reads the *updated* leaves at best_leaf (the
-        # left child's gain) — record the parent's chosen gain instead:
-        record["split_gain"] = jnp.where(valid, state.leaves.gain[best_leaf],
-                                         0.0)
-        return _GrowState(row_leaf, pool, leaves), record
+        return _GrowState(row_leaf, pool, leaves, used_features), record
 
     # unroll=2: a single-step scan body wrapping pallas_call lowers to a
     # pathologically slow while-loop on TPU (~1000x); any unrolling avoids it
@@ -233,7 +314,7 @@ def grow_tree(bins_fm: jax.Array,
                               unroll=2 if L > 2 else 1)
 
     leaves = state.leaves
-    leaf_values = leaf_output(leaves.sum_grad, leaves.sum_hess, hp)
+    leaf_values = leaves.output
     num_leaves_out = 1 + jnp.sum(records["split_leaf"] >= 0).astype(jnp.int32)
 
     tree_arrays = TreeArrays(

@@ -168,7 +168,7 @@ def transform_matrix(data: np.ndarray, mappers, dtype) -> Optional[np.ndarray]:
     n, f = data.shape
     if any(m.is_categorical or m.bin_upper_bound is None for m in mappers):
         return None
-    data_cm = np.asfortranarray(data, np.float64)
+    data_cm = np.asfortranarray(data, np.float64)  # no-op if already F-order
     offsets = np.zeros(f + 1, np.int64)
     for j, m in enumerate(mappers):
         offsets[j + 1] = offsets[j] + len(m.bin_upper_bound)

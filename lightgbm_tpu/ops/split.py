@@ -34,6 +34,8 @@ class SplitHyperParams(NamedTuple):
     min_sum_hessian_in_leaf: jax.Array
     min_gain_to_split: jax.Array
     max_delta_step: jax.Array
+    path_smooth: jax.Array     # (ref: config.h path_smooth)
+    cegb_split_pen: jax.Array  # cegb_tradeoff * cegb_penalty_split
 
     @classmethod
     def from_config(cls, cfg) -> "SplitHyperParams":
@@ -46,6 +48,9 @@ class SplitHyperParams(NamedTuple):
                 max(cfg.min_sum_hessian_in_leaf, K_EPSILON), f),
             min_gain_to_split=jnp.asarray(cfg.min_gain_to_split, f),
             max_delta_step=jnp.asarray(cfg.max_delta_step, f),
+            path_smooth=jnp.asarray(cfg.path_smooth, f),
+            cegb_split_pen=jnp.asarray(
+                cfg.cegb_tradeoff * cfg.cegb_penalty_split, f),
         )
 
 
@@ -65,6 +70,8 @@ class FeatureMeta(NamedTuple):
     is_categorical: jax.Array
     monotone: jax.Array
     penalty: jax.Array
+    cegb_feat: jax.Array  # [F] additive gain penalty (CEGB coupled, pre-scaled)
+    cegb_lazy: jax.Array  # [F] per-row additive penalty (CEGB lazy, pre-scaled)
 
 
 class SplitInfo(NamedTuple):
@@ -108,18 +115,38 @@ def leaf_gain(sum_grad, sum_hess, hp: SplitHyperParams):
                                   leaf_output(sum_grad, sum_hess, hp), hp)
 
 
+def smooth_output(raw, count, parent_output, hp: SplitHyperParams):
+    """Path smoothing: pull a leaf's output toward its parent's,
+    weighted by leaf size (ref: feature_histogram.hpp
+    CalculateSplittedLeafOutput USE_SMOOTHING branch:
+    w' = w * (n/a)/(n/a+1) + parent/(n/a+1), a = path_smooth)."""
+    ratio = count / jnp.maximum(hp.path_smooth, K_EPSILON)
+    smoothed = (raw * ratio + parent_output) / (ratio + 1.0)
+    return jnp.where(hp.path_smooth > 0, smoothed, raw)
+
+
+def leaf_output_smooth(sum_grad, sum_hess, count, parent_output,
+                       hp: SplitHyperParams):
+    return smooth_output(leaf_output(sum_grad, sum_hess, hp), count,
+                         parent_output, hp)
+
+
 def find_best_split(hist: jax.Array,
                     parent_sum_grad: jax.Array,
                     parent_sum_hess: jax.Array,
                     parent_count: jax.Array,
                     meta: FeatureMeta,
                     hp: SplitHyperParams,
-                    feature_mask: jax.Array) -> SplitInfo:
+                    feature_mask: jax.Array,
+                    parent_output=None) -> SplitInfo:
     """Find the best numerical split across all features for one leaf.
 
     hist: [F, B, 3]; parent_*: scalars; feature_mask: [F] bool (feature
-    fraction / interaction constraints). Returns scalar SplitInfo.
+    fraction / interaction constraints); parent_output: scalar output of
+    the leaf being split (path smoothing). Returns scalar SplitInfo.
     """
+    if parent_output is None:
+        parent_output = jnp.float32(0.0)
     num_features, num_bin_slots, _ = hist.shape
     prefix = jnp.cumsum(hist, axis=1)  # [F, B, 3]
     t_idx = jnp.arange(num_bin_slots, dtype=jnp.int32)[None, :]  # [1, B]
@@ -135,11 +162,17 @@ def find_best_split(hist: jax.Array,
 
     parent = jnp.stack([parent_sum_grad, parent_sum_hess, parent_count])
 
+    # CEGB delta per feature (ref: cost_effective_gradient_boosting.hpp
+    # DeltaGain: tradeoff*penalty_split*n_leaf + coupled-first-use +
+    # lazy per-row costs; coupled/lazy are pre-scaled by tradeoff on host)
+    cegb_delta = (meta.cegb_feat
+                  + (hp.cegb_split_pen + meta.cegb_lazy) * parent_count)
+
     def eval_variant(left, right, valid_extra):
         gl, hl, cl = left[..., GRAD], left[..., HESS], left[..., COUNT]
         gr, hr, cr = right[..., GRAD], right[..., HESS], right[..., COUNT]
-        out_l = leaf_output(gl, hl, hp)
-        out_r = leaf_output(gr, hr, hp)
+        out_l = smooth_output(leaf_output(gl, hl, hp), cl, parent_output, hp)
+        out_r = smooth_output(leaf_output(gr, hr, hp), cr, parent_output, hp)
         gain = (leaf_gain_given_output(gl, hl, out_l, hp)
                 + leaf_gain_given_output(gr, hr, out_r, hp))
         # monotone constraints, basic method (ref: monotone_constraints.hpp:466):
@@ -157,7 +190,7 @@ def find_best_split(hist: jax.Array,
             & (hr >= hp.min_sum_hessian_in_leaf)
             & feature_mask[:, None]
         )
-        gain = gain * meta.penalty[:, None]
+        gain = gain * meta.penalty[:, None] - cegb_delta[:, None]
         return jnp.where(valid, gain, K_MIN_SCORE)
 
     is_cat = meta.is_categorical[:, None]
@@ -195,7 +228,13 @@ def find_best_split(hist: jax.Array,
     left = jnp.where(variant_b, parent - rb, jnp.where(variant_c, lc_, la))
     right = parent - left
 
-    parent_gain = leaf_gain(parent_sum_grad, parent_sum_hess, hp)
+    # with smoothing, the parent's gain is evaluated at its actual
+    # (smoothed) output (ref: FindBestThresholdFromHistogram min_gain_shift)
+    parent_gain = jnp.where(
+        hp.path_smooth > 0,
+        leaf_gain_given_output(parent_sum_grad, parent_sum_hess,
+                               parent_output, hp),
+        leaf_gain(parent_sum_grad, parent_sum_hess, hp))
     gain = best_gain_raw - parent_gain - hp.min_gain_to_split
     gain = jnp.where(best_gain_raw <= K_MIN_SCORE * 0.5, K_MIN_SCORE, gain)
 
@@ -212,6 +251,8 @@ def find_best_split(hist: jax.Array,
         default_left=default_left,
         left_sum_grad=left[GRAD], left_sum_hess=left[HESS], left_count=left[COUNT],
         right_sum_grad=right[GRAD], right_sum_hess=right[HESS], right_count=right[COUNT],
-        left_output=leaf_output(left[GRAD], left[HESS], hp),
-        right_output=leaf_output(right[GRAD], right[HESS], hp),
+        left_output=leaf_output_smooth(left[GRAD], left[HESS], left[COUNT],
+                                       parent_output, hp),
+        right_output=leaf_output_smooth(right[GRAD], right[HESS],
+                                        right[COUNT], parent_output, hp),
     )

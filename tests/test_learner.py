@@ -23,6 +23,8 @@ def _meta(num_bins, missing=None, cat=None):
         is_categorical=jnp.asarray(cat if cat is not None else [False] * f),
         monotone=jnp.asarray([0] * f, jnp.int8),
         penalty=jnp.asarray([1.0] * f, jnp.float32),
+        cegb_feat=jnp.zeros(f, jnp.float32),
+        cegb_lazy=jnp.zeros(f, jnp.float32),
     )
 
 

@@ -1,0 +1,176 @@
+"""Advanced learner features: forced splits, interaction constraints,
+path smoothing, CEGB (ref: serial_tree_learner.cpp:628 ForceSplits,
+col_sampler.hpp, feature_histogram.hpp USE_SMOOTHING,
+cost_effective_gradient_boosting.hpp)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from conftest import make_binary, make_regression
+
+import lightgbm_tpu as lgb
+
+
+def _train(X, y, params, rounds=10):
+    p = {"objective": "regression", "verbosity": -1, "num_leaves": 15,
+         "min_data_in_leaf": 5, **params}
+    return lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=rounds)
+
+
+class TestForcedSplits:
+    def test_root_split_is_forced(self, tmp_path):
+        X, y = make_regression(800, 6)
+        fs = tmp_path / "forced.json"
+        # feature 3 is noise — the learner would never choose it first
+        fs.write_text(json.dumps({"feature": 3, "threshold": 0.0}))
+        bst = _train(X, y, {"forcedsplits_filename": str(fs)}, rounds=3)
+        for it in bst._gbdt.models:
+            for tree in it:
+                assert tree.split_feature[0] == 3
+                assert abs(tree.threshold[0] - 0.0) < 0.5
+
+    def test_nested_forced_splits(self, tmp_path):
+        X, y = make_regression(800, 6)
+        fs = tmp_path / "forced.json"
+        fs.write_text(json.dumps({
+            "feature": 3, "threshold": 0.0,
+            "left": {"feature": 4, "threshold": 0.5},
+            "right": {"feature": 5, "threshold": -0.5}}))
+        bst = _train(X, y, {"forcedsplits_filename": str(fs)}, rounds=2)
+        tree = bst._gbdt.models[0][0]
+        assert tree.split_feature[0] == 3
+        # splits 1 and 2 are the forced children (BFS order)
+        assert {tree.split_feature[1], tree.split_feature[2]} == {4, 5}
+
+    def test_forced_split_still_learns(self, tmp_path):
+        X, y = make_binary(1000, 6)
+        fs = tmp_path / "forced.json"
+        fs.write_text(json.dumps({"feature": 5, "threshold": 0.0}))
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "forcedsplits_filename": str(fs)},
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+        preds = bst.predict(X)
+        assert preds[y == 1].mean() > preds[y == 0].mean() + 0.2
+
+    def test_reference_example_forced_splits(self):
+        import os
+        path = ("/root/reference/examples/binary_classification/"
+                "forced_splits.json")
+        if not os.path.exists(path):
+            pytest.skip("reference examples not mounted")
+        X, y = make_binary(500, 30)
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "forcedsplits_filename": path},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+        spec = json.load(open(path))
+        tree = bst._gbdt.models[0][0]
+        assert tree.split_feature[0] == spec["feature"]
+
+
+class TestInteractionConstraints:
+    def test_constrained_features_never_mix(self):
+        X, y = make_regression(1000, 6)
+        bst = _train(X, y, {"interaction_constraints": [[0, 1], [2, 3, 4, 5]]},
+                     rounds=10)
+        groups = [{0, 1}, {2, 3, 4, 5}]
+        for it in bst._gbdt.models:
+            for tree in it:
+                # every root->leaf path must stay inside one group
+                def walk(node, used):
+                    if node < 0:
+                        assert any(used <= g for g in groups), used
+                        return
+                    used = used | {int(tree.split_feature[node])}
+                    walk(tree.left_child[node], used)
+                    walk(tree.right_child[node], used)
+                if tree.num_internal:
+                    walk(0, set())
+
+    def test_single_group_restricts_features(self):
+        X, y = make_regression(800, 6)
+        bst = _train(X, y, {"interaction_constraints": [[1, 2]]}, rounds=5)
+        for it in bst._gbdt.models:
+            for tree in it:
+                for s in range(tree.num_internal):
+                    assert int(tree.split_feature[s]) in (1, 2)
+
+    def test_accuracy_unconstrained_vs_full_group(self):
+        X, y = make_regression(800, 6)
+        b1 = _train(X, y, {}, rounds=10)
+        b2 = _train(X, y, {"interaction_constraints": [[0, 1, 2, 3, 4, 5]]},
+                    rounds=10)
+        np.testing.assert_allclose(b1.predict(X), b2.predict(X), rtol=1e-5)
+
+
+class TestPathSmoothing:
+    def test_smoothing_shrinks_leaf_values(self):
+        X, y = make_regression(500, 6)
+        b0 = _train(X, y, {}, rounds=5)
+        b1 = _train(X, y, {"path_smooth": 100.0}, rounds=5)
+        # smoothed leaves are pulled toward parents -> smaller extremes
+        lv0 = np.concatenate([t.leaf_value for it in b0._gbdt.models
+                              for t in it])
+        lv1 = np.concatenate([t.leaf_value for it in b1._gbdt.models
+                              for t in it])
+        assert np.abs(lv1).max() < np.abs(lv0).max()
+
+    def test_smoothing_zero_is_identity(self):
+        X, y = make_regression(500, 6)
+        b0 = _train(X, y, {}, rounds=5)
+        b1 = _train(X, y, {"path_smooth": 0.0}, rounds=5)
+        np.testing.assert_allclose(b0.predict(X), b1.predict(X), rtol=1e-6)
+
+    def test_smoothing_still_learns(self):
+        X, y = make_regression(800, 6)
+        bst = _train(X, y, {"path_smooth": 10.0}, rounds=30)
+        pred = bst.predict(X)
+        ss_res = ((y - pred) ** 2).sum()
+        ss_tot = ((y - y.mean()) ** 2).sum()
+        assert 1 - ss_res / ss_tot > 0.7
+
+
+class TestCEGB:
+    def test_split_penalty_reduces_tree_size(self):
+        X, y = make_regression(500, 6)
+        b0 = _train(X, y, {}, rounds=5)
+        b1 = _train(X, y, {"cegb_penalty_split": 1.0,
+                           "cegb_tradeoff": 1.0}, rounds=5)
+        n0 = sum(t.num_leaves for it in b0._gbdt.models for t in it)
+        n1 = sum(t.num_leaves for it in b1._gbdt.models for t in it)
+        assert n1 < n0
+
+    def test_coupled_penalty_concentrates_features(self):
+        X, y = make_regression(1000, 6, seed=3)
+        pen = [10.0] * 6
+        b = _train(X, y, {"cegb_penalty_feature_coupled": pen,
+                          "cegb_tradeoff": 1.0}, rounds=10)
+        used = set()
+        for it in b._gbdt.models:
+            for t in it:
+                used |= set(t.split_feature[:t.num_internal].tolist())
+        b0 = _train(X, y, {}, rounds=10)
+        used0 = set()
+        for it in b0._gbdt.models:
+            for t in it:
+                used0 |= set(t.split_feature[:t.num_internal].tolist())
+        assert len(used) <= len(used0)
+
+    def test_lazy_penalty_trains(self):
+        X, y = make_regression(500, 6)
+        b = _train(X, y, {"cegb_penalty_feature_lazy": [1e-4] * 6,
+                          "cegb_tradeoff": 1.0}, rounds=10)
+        pred = b.predict(X)
+        assert np.corrcoef(pred, y)[0, 1] > 0.7
+
+
+class TestMaxDeltaStep:
+    def test_leaf_values_clipped(self):
+        X, y = make_regression(500, 6)
+        y = y * 100.0  # large outputs
+        bst = _train(X, y, {"max_delta_step": 0.5, "learning_rate": 1.0,
+                            "boost_from_average": False}, rounds=2)
+        for it in bst._gbdt.models:
+            for t in it:
+                assert np.abs(t.leaf_value).max() <= 0.5 + 1e-5

@@ -54,9 +54,6 @@ def test_find_bounds_parity(columns):
 def test_transform_parity(columns):
     for name, vals in columns.items():
         m = BinMapper().fit(vals.copy(), max_bin=63, min_data_in_bin=3)
-        py = np.searchsorted(m.bin_upper_bound,
-                             np.where(np.isnan(vals), 0.0, vals),
-                             side="left")
         nat = native.transform_column(vals, m.bin_upper_bound,
                                       m.missing_type, m.default_bin,
                                       m.num_bins)
