@@ -29,7 +29,7 @@ from .dataset import BinnedDataset
 from .learner import grow_tree, replay_tree
 from .objectives import ObjectiveFunction, create_objective
 from .ops import histogram as hist_ops
-from .ops.split import FeatureMeta, SplitHyperParams
+from .ops.split import FeatureMeta, SplitHyperParams, leaf_output
 from .tree import Tree
 
 K_EPSILON = 1e-35
@@ -83,15 +83,16 @@ class GBDT:
         num_bins, missing, default_bin, is_cat = \
             train_set.feature_meta_arrays()
         mono = np.zeros(train_set.num_features, np.int8)
-        if config.monotone_constraints is not None:
-            mc = np.asarray(_multi_value(config.monotone_constraints),
-                            np.int8)
+        mc_vals = _multi_value(config.monotone_constraints)
+        if mc_vals is not None:
+            mc = np.asarray(mc_vals, np.int8)
             for j, col in enumerate(train_set.used_features):
                 if col < len(mc):
                     mono[j] = mc[col]
         penalty = np.ones(train_set.num_features, np.float32)
-        if config.feature_contri is not None:
-            fc = np.asarray(_multi_value(config.feature_contri), np.float32)
+        fc_vals = _multi_value(config.feature_contri)
+        if fc_vals is not None:
+            fc = np.asarray(fc_vals, np.float32)
             for j, col in enumerate(train_set.used_features):
                 if col < len(fc):
                     penalty[j] = fc[col]
@@ -194,6 +195,15 @@ class GBDT:
             if raw_f not in used_map:
                 continue  # feature dropped as trivial — skip this subtree
             j = used_map[raw_f]
+            if ts.mappers[j].is_categorical:
+                # categorical partitioning is bin == threshold, which the
+                # forced cumulative gather cannot express — skip with a
+                # warning rather than corrupt the split
+                import warnings
+                warnings.warn(
+                    f"forced split on categorical feature {raw_f} is not "
+                    "supported; skipping this forced subtree")
+                continue
             tbin = int(self.train_set.mappers[j].transform(
                 np.asarray([float(node["threshold"])]))[0])
             leaf_arr[s], feat_arr[s], thr_arr[s] = leaf, j, tbin
@@ -312,6 +322,45 @@ class GBDT:
         scale = jnp.where(is_other, amplify, 1.0)
         return mask, scale
 
+    def _discretize_in_jit(self, key, grad, hess):
+        """Gradient quantization with stochastic rounding (traced;
+        ref: gradient_discretizer.cpp DiscretizeGradients — g_scale =
+        max|g| / (bins/2), h_scale = max|h| / bins (max|h| when the
+        hessian is constant), int value = trunc-toward-zero of
+        scaled ± uniform). Returns dequantized (grad, hess): the learner's
+        f32 histograms then accumulate exact multiples of the scales, the
+        same statistics the reference's integer histograms hold."""
+        cfg = self.config
+        bins = max(int(cfg.num_grad_quant_bins), 2)
+        const_h = (self.objective is not None and
+                   self.objective.is_constant_hessian)
+        max_g = jnp.maximum(jnp.max(jnp.abs(grad)), K_EPSILON)
+        max_h = jnp.maximum(jnp.max(jnp.abs(hess)), K_EPSILON)
+        g_scale = max_g / (bins // 2)
+        h_scale = max_h if const_h else max_h / bins
+        if cfg.stochastic_rounding:
+            kg, kh = jax.random.split(key)
+            u_g = jax.random.uniform(kg, grad.shape)
+            u_h = jax.random.uniform(kh, hess.shape)
+        else:
+            u_g = u_h = 0.5
+        g_int = jnp.trunc(grad / g_scale + jnp.sign(grad) * u_g)
+        h_int = jnp.trunc(hess / h_scale + u_h)
+        return g_int * g_scale, h_int * h_scale
+
+    def _renew_leaves_in_jit(self, rec, row_leaf, true_grad, true_hess,
+                             mask):
+        """Recompute leaf outputs from the un-quantized gradients
+        (ref: gradient_discretizer.hpp RenewIntGradTreeOutput,
+        quant_train_renew_leaf)."""
+        L = self._static["num_leaves"]
+        w = mask
+        sums_g = jnp.zeros(L, jnp.float32).at[row_leaf].add(true_grad * w)
+        sums_h = jnp.zeros(L, jnp.float32).at[row_leaf].add(true_hess * w)
+        renewed = leaf_output(sums_g, sums_h, self.hp)
+        new_vals = jnp.where(rec.leaf_count > 0, renewed, rec.leaf_value)
+        return rec._replace(leaf_value=new_vals)
+
     def _feature_mask_in_jit(self, key):
         cfg = self.config
         f = self.train_set.num_features
@@ -344,11 +393,19 @@ class GBDT:
                     mask, scale = self._goss_in_jit(
                         jax.random.fold_in(key, 100 + k), grad, hess)
                     grad, hess = grad * scale, hess * scale
+                true_grad, true_hess = grad, hess
+                if self.config.use_quantized_grad:
+                    grad, hess = self._discretize_in_jit(
+                        jax.random.fold_in(key, 300 + k), grad, hess)
                 fmask = self._feature_mask_in_jit(
                     jax.random.fold_in(key, 200 + k))
                 rec, row_leaf = grow(self.bins_fm, grad, hess, mask, fmask,
                                      self.feature_meta, self.hp,
                                      self.max_depth, self._forced)
+                if self.config.use_quantized_grad and \
+                        self.config.quant_train_renew_leaf:
+                    rec = self._renew_leaves_in_jit(
+                        rec, row_leaf, true_grad, true_hess, mask)
                 # 1-leaf trees contribute nothing (the reference stops
                 # training instead, gbdt.cpp should_continue)
                 leaf_vals = jnp.where(rec.num_leaves > 1,
@@ -523,11 +580,20 @@ class GBDT:
                     custom_grad is None:
                 mask, scale = self._goss_mask(grad, hess)
                 grad, hess = grad * scale, hess * scale
+            true_grad, true_hess = grad, hess
+            if self.config.use_quantized_grad:
+                qkey = jax.random.fold_in(self._bagging_key,
+                                          self.iter + (3 << 20) + k)
+                grad, hess = self._discretize_in_jit(qkey, grad, hess)
             feature_mask = self._feature_mask()
 
             record, row_leaf = self._grow(
                 self.bins_fm, grad, hess, mask, feature_mask,
                 self.feature_meta, self.hp, self.max_depth, self._forced)
+            if self.config.use_quantized_grad and \
+                    self.config.quant_train_renew_leaf:
+                record = self._renew_leaves_in_jit(
+                    record, row_leaf, true_grad, true_hess, mask)
 
             rec_host = _tree_record_to_host(record)
             tree = Tree.from_arrays(rec_host, self.train_set.mappers,

@@ -194,42 +194,50 @@ def grow_tree(bins_fm: jax.Array,
 
     def step(state: _GrowState, step_idx):
         leaves = state.leaves
-        f_leaf = forced_leaf_arr[step_idx]
-        use_forced = f_leaf >= 0
-        best_leaf = jnp.where(use_forced, f_leaf,
-                              jnp.argmax(leaves.gain).astype(jnp.int32))
         new_leaf = (step_idx + 1).astype(jnp.int32)
 
-        feat = jnp.where(use_forced, forced_feat_arr[step_idx],
-                         leaves.feature[best_leaf])
-        thr = jnp.where(use_forced, forced_thr_arr[step_idx],
-                        leaves.threshold[best_leaf])
+        # --- forced candidate (ref: serial_tree_learner.cpp:628
+        # ForceSplits): stats gathered from the target leaf's histogram;
+        # aborted (falling back to the best split) when degenerate or
+        # loss-increasing, like the reference's abort_last_forced_split
+        f_leaf = jnp.maximum(forced_leaf_arr[step_idx], 0)
+        f_feat = jnp.maximum(forced_feat_arr[step_idx], 0)
+        f_thr = forced_thr_arr[step_idx]
+        f_hist = state.pool[f_leaf]
+        bin_le = (jnp.arange(f_hist.shape[1]) <= f_thr)
+        f_left = jnp.sum(f_hist[f_feat] * bin_le[:, None], axis=0)
+        f_pg, f_ph, f_pc = (leaves.sum_grad[f_leaf], leaves.sum_hess[f_leaf],
+                            leaves.count[f_leaf])
+        f_lg, f_lh, f_lc = f_left[GRAD], f_left[HESS], f_left[COUNT]
+        f_rg, f_rh, f_rc = f_pg - f_lg, f_ph - f_lh, f_pc - f_lc
+        f_parent_out = leaves.output[f_leaf]
+        f_out_l = leaf_output_smooth(f_lg, f_lh, f_lc, f_parent_out, hp)
+        f_out_r = leaf_output_smooth(f_rg, f_rh, f_rc, f_parent_out, hp)
+        f_gain = (leaf_gain_given_output(f_lg, f_lh, f_out_l, hp)
+                  + leaf_gain_given_output(f_rg, f_rh, f_out_r, hp)
+                  - leaf_gain_given_output(f_pg, f_ph, f_parent_out, hp))
+        use_forced = (forced_leaf_arr[step_idx] >= 0) & (f_lc > 0) & \
+            (f_rc > 0) & (f_gain > 0)
+
+        best_leaf = jnp.where(use_forced, f_leaf,
+                              jnp.argmax(leaves.gain).astype(jnp.int32))
+        feat = jnp.where(use_forced, f_feat, leaves.feature[best_leaf])
+        thr = jnp.where(use_forced, f_thr, leaves.threshold[best_leaf])
         # forced splits route missing by the zero-bin rule
-        # (ref: ForceSplits computes the split like any other candidate)
         forced_dleft = (meta.missing_type[feat] == split_ops.MISSING_ZERO) \
             & (meta.default_bin[feat] <= thr)
         dleft = jnp.where(use_forced, forced_dleft,
                           leaves.default_left[best_leaf])
 
-        # --- children stats: stored candidate, or recomputed from the
-        # parent histogram for a forced (feature, threshold)
+        # --- children stats: stored candidate, or the forced gather
         pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
                       leaves.count[best_leaf])
-        parent_hist_pre = state.pool[best_leaf]
-        bin_le = (jnp.arange(parent_hist_pre.shape[1]) <= thr)
-        forced_left = jnp.sum(parent_hist_pre[feat] * bin_le[:, None], axis=0)
-        lg = jnp.where(use_forced, forced_left[GRAD],
-                       leaves.left_sum_grad[best_leaf])
-        lh = jnp.where(use_forced, forced_left[HESS],
-                       leaves.left_sum_hess[best_leaf])
-        lc = jnp.where(use_forced, forced_left[COUNT],
-                       leaves.left_count[best_leaf])
+        lg = jnp.where(use_forced, f_lg, leaves.left_sum_grad[best_leaf])
+        lh = jnp.where(use_forced, f_lh, leaves.left_sum_hess[best_leaf])
+        lc = jnp.where(use_forced, f_lc, leaves.left_count[best_leaf])
         rg, rh, rc = pg - lg, ph - lh, pc - lc
 
-        # a forced split must leave data on both sides; a best split must
-        # have positive gain
-        valid = jnp.where(use_forced, (lc > 0) & (rc > 0),
-                          leaves.gain[best_leaf] > 0.0)
+        valid = use_forced | (leaves.gain[best_leaf] > 0.0)
 
         # --- partition rows (left keeps best_leaf id, right -> new_leaf)
         row_leaf = part_ops.apply_split(
@@ -285,11 +293,7 @@ def grow_tree(bins_fm: jax.Array,
 
         # the parent's chosen gain, before leaves is overwritten (for a
         # forced split: the actual gain of the forced threshold)
-        forced_gain = (leaf_gain_given_output(lg, lh, out_l, hp)
-                       + leaf_gain_given_output(rg, rh, out_r, hp)
-                       - leaf_gain_given_output(pg, ph, parent_out, hp))
-        chosen_gain = jnp.where(use_forced, forced_gain,
-                                leaves.gain[best_leaf])
+        chosen_gain = jnp.where(use_forced, f_gain, leaves.gain[best_leaf])
 
         leaves = _store_split(leaves, best_leaf, split_l, child_depth, out_l,
                               lg, lh, lc, valid)

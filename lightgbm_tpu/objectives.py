@@ -53,6 +53,13 @@ class ObjectiveFunction:
     def get_gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
         raise NotImplementedError
 
+    @property
+    def is_constant_hessian(self) -> bool:
+        """(ref: ObjectiveFunction::IsConstantHessian — true when every
+        row's hessian is the same, letting quantized training keep full
+        hessian precision.)"""
+        return False
+
     def boost_from_score(self, class_id: int = 0) -> float:
         """Initial raw score (ref: BoostFromScore per objective)."""
         return 0.0
@@ -77,6 +84,10 @@ class ObjectiveFunction:
 # ---------------------------------------------------------------------------
 class RegressionL2(ObjectiveFunction):
     name = "regression"
+
+    @property
+    def is_constant_hessian(self) -> bool:
+        return self.weight_np is None and type(self) is RegressionL2
 
     def get_gradients(self, score):
         return self._apply_weight(score - self.label,

@@ -1,0 +1,82 @@
+"""Quantized-gradient training (ref: gradient_discretizer.{hpp,cpp},
+config.h use_quantized_grad / num_grad_quant_bins /
+quant_train_renew_leaf / stochastic_rounding)."""
+
+import numpy as np
+
+from conftest import make_binary, make_regression
+
+import lightgbm_tpu as lgb
+
+
+def _auc(label, prob):
+    pos, neg = prob[label == 1], prob[label == 0]
+    return float((pos[:, None] > neg[None, :]).mean()
+                 + 0.5 * (pos[:, None] == neg[None, :]).mean())
+
+
+class TestQuantizedTraining:
+    def test_binary_accuracy_close_to_full_precision(self):
+        X, y = make_binary(2000, 10)
+        base = lgb.train({"objective": "binary", "verbosity": -1},
+                         lgb.Dataset(X, label=y), num_boost_round=30)
+        quant = lgb.train({"objective": "binary", "verbosity": -1,
+                           "use_quantized_grad": True,
+                           "num_grad_quant_bins": 4},
+                          lgb.Dataset(X, label=y), num_boost_round=30)
+        auc_full = _auc(y, base.predict(X))
+        auc_q = _auc(y, quant.predict(X))
+        assert auc_q > auc_full - 0.02, (auc_full, auc_q)
+
+    def test_regression_with_renew_leaf(self):
+        X, y = make_regression(1500, 8)
+        quant = lgb.train({"objective": "regression", "verbosity": -1,
+                           "use_quantized_grad": True,
+                           "quant_train_renew_leaf": True,
+                           "num_grad_quant_bins": 4},
+                          lgb.Dataset(X, label=y), num_boost_round=30)
+        pred = quant.predict(X)
+        ss_res = ((y - pred) ** 2).sum()
+        ss_tot = ((y - y.mean()) ** 2).sum()
+        assert 1 - ss_res / ss_tot > 0.8
+
+    def test_more_bins_is_closer_to_full(self):
+        X, y = make_regression(1500, 8, seed=2)
+
+        def mse(params, rounds=20):
+            b = lgb.train({"objective": "regression", "verbosity": -1,
+                           **params}, lgb.Dataset(X, label=y),
+                          num_boost_round=rounds)
+            return float(((y - b.predict(X)) ** 2).mean())
+
+        full = mse({})
+        q4 = mse({"use_quantized_grad": True, "num_grad_quant_bins": 4})
+        q16 = mse({"use_quantized_grad": True, "num_grad_quant_bins": 16})
+        # quantization shouldn't blow up the fit; more bins ≈ closer
+        assert q16 < full * 1.5
+        assert q4 < full * 2.5
+
+    def test_deterministic_rounding_mode(self):
+        X, y = make_regression(800, 6)
+        p = {"objective": "regression", "verbosity": -1,
+             "use_quantized_grad": True, "stochastic_rounding": False}
+        b1 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5)
+        b2 = lgb.train(p, lgb.Dataset(X, label=y), num_boost_round=5)
+        np.testing.assert_allclose(b1.predict(X), b2.predict(X))
+
+    def test_quantized_with_goss(self):
+        X, y = make_binary(2000, 8)
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "use_quantized_grad": True,
+                         "data_sample_strategy": "goss"},
+                        lgb.Dataset(X, label=y), num_boost_round=20)
+        assert _auc(y, bst.predict(X)) > 0.8
+
+    def test_quantized_multiclass(self):
+        from conftest import make_multiclass
+        X, y = make_multiclass(1200, 8, k=4)
+        bst = lgb.train({"objective": "multiclass", "num_class": 4,
+                         "verbosity": -1, "use_quantized_grad": True},
+                        lgb.Dataset(X, label=y), num_boost_round=15)
+        acc = (bst.predict(X).argmax(1) == y).mean()
+        assert acc > 0.75
