@@ -270,6 +270,9 @@ class GBDT:
             # coupled penalties change per iteration with the used-feature
             # set; needs the host loop
             return False
+        if self.config.linear_tree:
+            # per-leaf least-squares fits run on host
+            return False
         # objectives that renew leaf outputs need per-iteration host work
         renews = type(self.objective).renew_tree_output is not \
             ObjectiveFunction.renew_tree_output
@@ -607,10 +610,32 @@ class GBDT:
                         np.asarray(row_leaf), np.asarray(mask))
                     if renewed is not None:
                         tree = renewed
+                if self.config.linear_tree:
+                    raw = self.train_set.raw_data
+                    if raw is None:
+                        raise ValueError(
+                            "linear_tree requires raw feature values "
+                            "(unavailable for binary-loaded datasets)")
+                    from .linear import fit_linear_models
+                    fit_linear_models(
+                        tree, np.asarray(raw, np.float64),
+                        np.asarray(row_leaf), np.asarray(true_grad),
+                        np.asarray(true_hess), np.asarray(mask),
+                        self.config.linear_lambda)
                 tree.apply_shrinkage(self._tree_shrinkage())
-                leaf_vals = jnp.asarray(tree.leaf_value.astype(np.float32))
-                new_score_k = self._update_score(self.scores[k], leaf_vals,
-                                                 row_leaf)
+                if tree.is_linear:
+                    # within-leaf outputs vary by row: linear outputs over
+                    # the grower's row->leaf map (no re-traversal)
+                    vals = tree.predict_given_leaves(
+                        np.asarray(self.train_set.raw_data, np.float64),
+                        np.asarray(row_leaf))
+                    new_score_k = self.scores[k] + jnp.asarray(
+                        vals.astype(np.float32))
+                else:
+                    leaf_vals = jnp.asarray(
+                        tree.leaf_value.astype(np.float32))
+                    new_score_k = self._update_score(self.scores[k],
+                                                     leaf_vals, row_leaf)
                 self.scores = self.scores.at[k].set(new_score_k)
                 self._update_valid_scores(tree, k)
                 if abs(self.init_scores[k]) > K_EPSILON and \
@@ -685,8 +710,16 @@ class GBDT:
             if tree.num_leaves > 1:
                 # recompute leaf assignment for train rows via binned predict
                 leaves = self._predict_leaf_binned_train(tree)
-                self.scores = self.scores.at[k].add(
-                    jnp.asarray((-tree.leaf_value.astype(np.float32)))[leaves])
+                if tree.is_linear:
+                    vals = tree.predict_given_leaves(
+                        np.asarray(self.train_set.raw_data, np.float64),
+                        np.asarray(leaves))
+                    self.scores = self.scores.at[k].add(
+                        jnp.asarray(-vals.astype(np.float32)))
+                else:
+                    self.scores = self.scores.at[k].add(
+                        jnp.asarray((-tree.leaf_value.astype(np.float32)))
+                        [leaves])
         for i, (vs, raw) in enumerate(self._valid_sets):
             for k, tree in enumerate(trees):
                 self._valid_scores[i] = self._valid_scores[i].at[k].add(

@@ -123,6 +123,9 @@ class BinnedDataset:
             f"Column_{i}" for i in range(num_total_features)]
         self.label_idx = label_idx
         self._device_cache: Dict[Any, Any] = {}
+        # raw feature values [N, F_total] (reference kept for linear-tree
+        # leaf fits; None for binary-loaded datasets)
+        self.raw_data: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------------
     @property
@@ -170,8 +173,10 @@ class BinnedDataset:
             used = reference.used_features
             bins_fm = _transform_all(data, mappers, used,
                                      reference.bins_fm.dtype)
-            return cls(bins_fm, mappers, used, reference.num_total_features,
-                       metadata, reference.feature_names)
+            ds = cls(bins_fm, mappers, used, reference.num_total_features,
+                     metadata, reference.feature_names)
+            ds.raw_data = data
+            return ds
 
         # sample rows for binning (ref: bin_construct_sample_cnt)
         sample_cnt = min(n, int(config.bin_construct_sample_cnt))
@@ -210,7 +215,9 @@ class BinnedDataset:
         max_bins = max((m.num_bins for m in mappers), default=1)
         dtype = np.uint8 if max_bins <= 256 else np.uint16
         bins_fm = _transform_all(data, mappers, used, dtype)
-        return cls(bins_fm, mappers, used, f, metadata, feature_names)
+        ds = cls(bins_fm, mappers, used, f, metadata, feature_names)
+        ds.raw_data = data
+        return ds
 
     # ------------------------------------------------------------------
     def device_bins(self):

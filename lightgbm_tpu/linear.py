@@ -1,0 +1,87 @@
+"""Linear-tree leaf models: per-leaf regularized weighted least squares.
+
+(ref: src/treelearner/linear_tree_learner.cpp:8,345 — after the tree
+structure is grown, every leaf gets a linear model over the numerical
+features on its root path, fit by solving (X'HX + lambda I) w = -X'g,
+the Newton step on this iteration's gradients. Eigen there; NumPy here —
+both are host-side solves over small per-leaf systems.)
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from .tree import Tree, _CATEGORICAL_MASK
+
+
+def _path_features(tree: Tree) -> List[List[int]]:
+    """Numerical split features on each leaf's root path, in path order."""
+    # parent of each internal node
+    parent = np.full(tree.num_internal, -1, np.int32)
+    for node in range(tree.num_internal):
+        for child in (tree.left_child[node], tree.right_child[node]):
+            if child >= 0:
+                parent[child] = node
+    out: List[List[int]] = []
+    for leaf in range(tree.num_leaves):
+        feats: List[int] = []
+        node = tree.leaf_parent[leaf]
+        while node >= 0:
+            if not (tree.decision_type[node] & _CATEGORICAL_MASK):
+                f = int(tree.split_feature[node])
+                if f not in feats:
+                    feats.append(f)
+            node = parent[node]
+        feats.reverse()
+        out.append(feats)
+    return out
+
+
+def fit_linear_models(tree: Tree, raw_data: np.ndarray,
+                      row_leaf: np.ndarray, grad: np.ndarray,
+                      hess: np.ndarray, sample_mask: np.ndarray,
+                      linear_lambda: float) -> None:
+    """Fit leaf linear models in place (ref: LinearTreeLearner::
+    CalculateLinear linear_tree_learner.cpp:345). Leaves whose system is
+    degenerate keep a constant model (coeffs empty, const = leaf_value)."""
+    if tree.num_internal == 0:
+        tree.is_linear = True
+        tree.leaf_const = tree.leaf_value.copy()
+        return
+    path_feats = _path_features(tree)
+    tree.is_linear = True
+    tree.leaf_const = tree.leaf_value.copy()
+    tree.leaf_coeff = [np.zeros(0)] * tree.num_leaves
+    tree.leaf_features = [[] for _ in range(tree.num_leaves)]
+
+    sel = sample_mask > 0
+    for leaf in range(tree.num_leaves):
+        feats = path_feats[leaf]
+        rows = np.flatnonzero((row_leaf == leaf) & sel)
+        if not feats or rows.size < len(feats) + 2:
+            continue
+        x = raw_data[np.ix_(rows, feats)]
+        ok = ~np.isnan(x).any(axis=1)
+        if ok.sum() < len(feats) + 2:
+            continue
+        x = x[ok]
+        g = grad[rows][ok].astype(np.float64)
+        h = hess[rows][ok].astype(np.float64)
+        # design with bias column; Newton system (X'HX + lam I)w = -X'g
+        xb = np.hstack([x, np.ones((x.shape[0], 1))])
+        xth = xb * h[:, None]
+        a = xth.T @ xb
+        k = len(feats)
+        a[np.arange(k), np.arange(k)] += linear_lambda
+        b = -(xb.T @ g)
+        try:
+            w = np.linalg.solve(a, b)
+        except np.linalg.LinAlgError:
+            continue
+        if not np.all(np.isfinite(w)):
+            continue
+        tree.leaf_features[leaf] = list(feats)
+        tree.leaf_coeff[leaf] = w[:-1]
+        tree.leaf_const[leaf] = float(w[-1])

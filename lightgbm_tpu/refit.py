@@ -19,6 +19,11 @@ def refit_booster(booster, data, label, decay_rate: float = 0.9,
     label = np.asarray(label, np.float32)
 
     new_booster = Booster(model_str=booster.model_to_string())
+    if any(getattr(t, "is_linear", False)
+           for t in new_booster._loaded.trees):
+        raise ValueError(
+            "refit is not supported for linear trees "
+            "(ref: the reference refuses RefitTree on linear models)")
     gbdt = booster._gbdt
     if gbdt is not None:
         cfg = gbdt.config

@@ -149,6 +149,10 @@ def _contrib_over_trees(tree_of, n_iters: int, k: int, data: np.ndarray,
                         num_feat: int, start_iteration: int,
                         num_iteration: int) -> np.ndarray:
     """Shared TreeSHAP accumulation. tree_of(it, ki) -> Tree."""
+    if n_iters > 0 and k > 0 and getattr(tree_of(0, 0), "is_linear", False):
+        raise ValueError(
+            "pred_contrib is not supported for linear trees (the "
+            "reference raises the same restriction)")
     n = data.shape[0]
     out = np.zeros((n, k, num_feat + 1))
     end = n_iters if num_iteration < 0 else min(

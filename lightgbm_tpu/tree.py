@@ -51,6 +51,11 @@ class Tree:
         self.cat_boundaries: List[int] = [0]
         self.cat_threshold: List[int] = []  # packed uint32 bitsets
         self.num_cat = 0
+        # linear-tree leaves (ref: tree.h is_linear_, LinearTreeLearner)
+        self.is_linear = False
+        self.leaf_const = np.zeros(n, np.float64)
+        self.leaf_coeff: List[np.ndarray] = [np.zeros(0)] * n
+        self.leaf_features: List[List[int]] = [[] for _ in range(n)]
 
     # ------------------------------------------------------------------
     @classmethod
@@ -127,10 +132,15 @@ class Tree:
         self.leaf_value *= rate
         self.internal_value *= rate
         self.shrinkage *= rate
+        if self.is_linear:
+            self.leaf_const *= rate
+            self.leaf_coeff = [c * rate for c in self.leaf_coeff]
 
     def add_bias(self, value: float) -> None:
         self.leaf_value += value
         self.internal_value += value
+        if self.is_linear:
+            self.leaf_const += value
 
     # ------------------------------------------------------------------
     def _decide(self, node: int, value: float) -> bool:
@@ -177,7 +187,34 @@ class Tree:
 
     def predict(self, data: np.ndarray) -> np.ndarray:
         """Vectorized batch prediction over raw feature values."""
-        return self.leaf_value[self.predict_leaf(data)]
+        return self.predict_given_leaves(data, self.predict_leaf(data))
+
+    def predict_given_leaves(self, data: np.ndarray,
+                             leaves: np.ndarray) -> np.ndarray:
+        """Leaf outputs for rows whose leaf assignment is already known
+        (e.g. the grower's row->leaf map — skips re-traversal)."""
+        if not self.is_linear:
+            return self.leaf_value[leaves]
+        # linear leaves: const + coeff . x, falling back to leaf_value for
+        # rows with NaN in any used feature (ref: tree.h linear predict)
+        out = self.leaf_value[leaves].copy()
+        order = np.argsort(leaves, kind="stable")
+        bounds = np.searchsorted(leaves[order],
+                                 np.arange(self.num_leaves + 1))
+        for leaf in range(self.num_leaves):
+            rows = order[bounds[leaf]:bounds[leaf + 1]]
+            if rows.size == 0:
+                continue
+            feats = self.leaf_features[leaf]
+            if not feats:
+                out[rows] = self.leaf_const[leaf]
+                continue
+            x = data[np.ix_(rows, feats)]
+            ok = ~np.isnan(x).any(axis=1)
+            lin = self.leaf_const[leaf] + x[ok] @ np.asarray(
+                self.leaf_coeff[leaf])
+            out[rows[ok]] = lin
+        return out
 
     def predict_leaf(self, data: np.ndarray) -> np.ndarray:
         n = data.shape[0]
@@ -256,6 +293,18 @@ class Tree:
                          " ".join(map(str, self.cat_boundaries)))
             lines.append("cat_threshold=" +
                          " ".join(map(str, self.cat_threshold)))
+        lines.append(f"is_linear={int(self.is_linear)}")
+        if self.is_linear:
+            # (ref: gbdt_model_text.cpp linear-tree block: per-leaf const,
+            # feature count, flattened feature ids and coefficients)
+            lines.append("leaf_const=" +
+                         " ".join(_fmt(v) for v in self.leaf_const))
+            lines.append("num_features=" + " ".join(
+                str(len(f)) for f in self.leaf_features))
+            lines.append("leaf_features=" + " ".join(
+                str(f) for feats in self.leaf_features for f in feats))
+            lines.append("leaf_coeff=" + " ".join(
+                _fmt(c) for coeffs in self.leaf_coeff for c in coeffs))
         lines.append(f"shrinkage={_fmt(self.shrinkage)}")
         lines.append("")
         return "\n".join(lines)
@@ -304,6 +353,22 @@ class Tree:
             tree.cat_boundaries = [int(x) for x in kv["cat_boundaries"].split()]
             tree.cat_threshold = [int(x) for x in kv["cat_threshold"].split()]
         tree.shrinkage = float(kv.get("shrinkage", 1.0))
+        if int(kv.get("is_linear", 0)):
+            tree.is_linear = True
+            tree.leaf_const = parse("leaf_const", np.float64,
+                                    np.zeros(num_leaves))
+            counts = [int(x) for x in kv.get("num_features", "").split()]
+            flat_feats = [int(x) for x in kv.get("leaf_features", "").split()]
+            flat_coeff = [float(x) for x in kv.get("leaf_coeff", "").split()]
+            pos = 0
+            tree.leaf_features, tree.leaf_coeff = [], []
+            for c in counts:
+                tree.leaf_features.append(flat_feats[pos:pos + c])
+                tree.leaf_coeff.append(np.asarray(flat_coeff[pos:pos + c]))
+                pos += c
+            while len(tree.leaf_features) < num_leaves:
+                tree.leaf_features.append([])
+                tree.leaf_coeff.append(np.zeros(0))
         return tree
 
     # ------------------------------------------------------------------

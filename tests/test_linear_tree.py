@@ -1,0 +1,96 @@
+"""Linear trees (ref: linear_tree_learner.cpp, config.h linear_tree,
+linear_lambda; model text keys is_linear/leaf_const/leaf_coeff)."""
+
+import numpy as np
+
+from conftest import make_regression
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import Booster, Dataset
+
+
+def test_linear_tree_beats_piecewise_constant_on_linear_data(rng):
+    # a piecewise-linear target: constant trees need many leaves, linear
+    # leaves should fit it nearly exactly
+    n = 2000
+    X = rng.uniform(-2, 2, (n, 3))
+    y = np.where(X[:, 0] > 0, 3.0 * X[:, 1] + 1.0, -2.0 * X[:, 1])
+    common = {"objective": "regression", "verbosity": -1, "num_leaves": 4,
+              "min_data_in_leaf": 20}
+    b_const = lgb.train(common, Dataset(X, label=y), num_boost_round=10)
+    b_lin = lgb.train({**common, "linear_tree": True},
+                      Dataset(X, label=y), num_boost_round=10)
+    mse_const = ((y - b_const.predict(X)) ** 2).mean()
+    mse_lin = ((y - b_lin.predict(X)) ** 2).mean()
+    assert mse_lin < mse_const * 0.5, (mse_const, mse_lin)
+
+
+def test_linear_tree_save_load_roundtrip(tmp_path, rng):
+    X = rng.uniform(-2, 2, (800, 4))
+    y = 2.0 * X[:, 0] + X[:, 1] * X[:, 2]
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "linear_tree": True, "num_leaves": 8},
+                    Dataset(X, label=y), num_boost_round=5)
+    path = tmp_path / "linear_model.txt"
+    bst.save_model(path)
+    text = path.read_text()
+    assert "is_linear=1" in text
+    assert "leaf_coeff=" in text
+    loaded = Booster(model_file=str(path))
+    np.testing.assert_allclose(loaded.predict(X), bst.predict(X),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_linear_tree_nan_falls_back_to_leaf_value(rng):
+    X = rng.uniform(-2, 2, (800, 3))
+    y = 3.0 * X[:, 1] + X[:, 0]
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "linear_tree": True, "num_leaves": 6},
+                    Dataset(X, label=y), num_boost_round=3)
+    Xq = X[:10].copy()
+    Xq[:, 1] = np.nan
+    preds = bst.predict(Xq)
+    assert np.all(np.isfinite(preds))
+
+
+def test_linear_lambda_regularizes(rng):
+    X = rng.uniform(-1, 1, (400, 2))
+    y = 5.0 * X[:, 0] + 0.1 * rng.randn(400)
+    b_small = lgb.train({"objective": "regression", "verbosity": -1,
+                         "linear_tree": True, "linear_lambda": 0.0,
+                         "num_leaves": 4},
+                        Dataset(X, label=y), num_boost_round=1)
+    b_big = lgb.train({"objective": "regression", "verbosity": -1,
+                       "linear_tree": True, "linear_lambda": 1e4,
+                       "num_leaves": 4},
+                      Dataset(X, label=y), num_boost_round=1)
+
+    def max_coef(b):
+        mx = 0.0
+        for it in b._gbdt.models:
+            for t in it:
+                for c in t.leaf_coeff:
+                    if len(c):
+                        mx = max(mx, np.abs(c).max())
+        return mx
+    assert max_coef(b_big) < max_coef(b_small)
+
+
+def test_linear_tree_binary_objective(rng):
+    X = rng.uniform(-2, 2, (1000, 4))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(float)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "linear_tree": True, "num_leaves": 8},
+                    Dataset(X, label=y), num_boost_round=10)
+    preds = bst.predict(X)
+    assert preds[y == 1].mean() > preds[y == 0].mean() + 0.3
+
+
+def test_linear_tree_refit_and_json_dump(rng):
+    X = rng.uniform(-2, 2, (500, 3))
+    y = X[:, 0] * 2
+    bst = lgb.train({"objective": "regression", "verbosity": -1,
+                     "linear_tree": True}, Dataset(X, label=y),
+                    num_boost_round=3)
+    d = bst.dump_model()
+    assert d["tree_info"]
