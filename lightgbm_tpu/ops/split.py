@@ -131,22 +131,16 @@ def leaf_output_smooth(sum_grad, sum_hess, count, parent_output,
                          parent_output, hp)
 
 
-def find_best_split(hist: jax.Array,
-                    parent_sum_grad: jax.Array,
-                    parent_sum_hess: jax.Array,
-                    parent_count: jax.Array,
-                    meta: FeatureMeta,
-                    hp: SplitHyperParams,
-                    feature_mask: jax.Array,
-                    parent_output=None) -> SplitInfo:
-    """Find the best numerical split across all features for one leaf.
-
-    hist: [F, B, 3]; parent_*: scalars; feature_mask: [F] bool (feature
-    fraction / interaction constraints); parent_output: scalar output of
-    the leaf being split (path smoothing). Returns scalar SplitInfo.
-    """
-    if parent_output is None:
-        parent_output = jnp.float32(0.0)
+def _gain_tensors(hist: jax.Array,
+                  parent_sum_grad: jax.Array,
+                  parent_sum_hess: jax.Array,
+                  parent_count: jax.Array,
+                  meta: FeatureMeta,
+                  hp: SplitHyperParams,
+                  feature_mask: jax.Array,
+                  parent_output):
+    """Candidate gains for every (feature, threshold, missing-direction)
+    variant. Returns (gains [F, B, 3], left_a, right_b, left_c, parent)."""
     num_features, num_bin_slots, _ = hist.shape
     prefix = jnp.cumsum(hist, axis=1)  # [F, B, 3]
     t_idx = jnp.arange(num_bin_slots, dtype=jnp.int32)[None, :]  # [1, B]
@@ -211,6 +205,44 @@ def find_best_split(hist: jax.Array,
                            base_valid_c)
 
     gains = jnp.stack([gains_a, gains_b, gains_c], axis=-1)  # [F, B, 3]
+    return gains, left_a, right_b, left_c, parent
+
+
+def per_feature_best_gain(hist, parent_sum_grad, parent_sum_hess,
+                          parent_count, meta: FeatureMeta,
+                          hp: SplitHyperParams, feature_mask,
+                          parent_output=None) -> jax.Array:
+    """Best candidate gain per feature ([F]) — the voting statistic each
+    worker computes from its local histograms (ref:
+    voting_parallel_tree_learner.cpp:353 local FindBestThreshold + MaxK)."""
+    if parent_output is None:
+        parent_output = jnp.float32(0.0)
+    gains, *_ = _gain_tensors(hist, parent_sum_grad, parent_sum_hess,
+                              parent_count, meta, hp, feature_mask,
+                              parent_output)
+    return jnp.max(gains, axis=(1, 2))
+
+
+def find_best_split(hist: jax.Array,
+                    parent_sum_grad: jax.Array,
+                    parent_sum_hess: jax.Array,
+                    parent_count: jax.Array,
+                    meta: FeatureMeta,
+                    hp: SplitHyperParams,
+                    feature_mask: jax.Array,
+                    parent_output=None) -> SplitInfo:
+    """Find the best numerical split across all features for one leaf.
+
+    hist: [F, B, 3]; parent_*: scalars; feature_mask: [F] bool (feature
+    fraction / interaction constraints); parent_output: scalar output of
+    the leaf being split (path smoothing). Returns scalar SplitInfo.
+    """
+    if parent_output is None:
+        parent_output = jnp.float32(0.0)
+    num_bin_slots = hist.shape[1]
+    gains, left_a, right_b, left_c, parent = _gain_tensors(
+        hist, parent_sum_grad, parent_sum_hess, parent_count, meta, hp,
+        feature_mask, parent_output)
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
     best_gain_raw = flat[best]

@@ -66,6 +66,79 @@ class DataParallelGBDT(_DataParallelMixin, GBDT):
         self._setup_sharding(num_shards)
 
 
+class VotingParallelGBDT(_DataParallelMixin, GBDT):
+    """PV-tree voting-parallel learner: rows sharded, local histograms,
+    top-k vote + candidate-only psum (ref:
+    voting_parallel_tree_learner.cpp; see parallel/voting.py)."""
+
+    def __init__(self, config: Config, train_set: BinnedDataset,
+                 objective: Optional[ObjectiveFunction] = None,
+                 num_shards: int = 0):
+        super().__init__(config, train_set, objective)
+        self._setup_sharding(num_shards)
+        if self._forced is not None or \
+                self._interaction_groups is not None:
+            import warnings
+            warnings.warn("forced splits / interaction constraints are "
+                          "not supported by tree_learner=voting; ignoring")
+        if self.mesh.size > 1:
+            from .voting import make_sharded_voting_grow
+            top_k = max(1, min(int(config.top_k),
+                               self.train_set.num_features))
+            grow = make_sharded_voting_grow(
+                self.mesh, top_k=top_k, hist_impl="xla", **self._static)
+
+            def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
+                              forced=None):
+                return grow(bins, g, h, m, fm, meta, hp, md)
+            self._grow = _grow_adapter
+
+    def _fast_path_ok(self, custom_grad) -> bool:
+        return False
+
+
+class FeatureParallelGBDT(GBDT):
+    """Feature-parallel learner: data replicated, feature slices per
+    shard, all-gathered best splits (ref:
+    feature_parallel_tree_learner.cpp; see parallel/feature_parallel.py)."""
+
+    def __init__(self, config: Config, train_set: BinnedDataset,
+                 objective: Optional[ObjectiveFunction] = None,
+                 num_shards: int = 0):
+        super().__init__(config, train_set, objective)
+        self.mesh = mesh_lib.get_mesh(num_shards)
+        if self._forced is not None or \
+                self._interaction_groups is not None:
+            import warnings
+            warnings.warn("forced splits / interaction constraints are "
+                          "not supported by tree_learner=feature; ignoring")
+        if self.mesh.size > 1:
+            # replicate everything; sharding is over the computation
+            self.bins_fm = mesh_lib.replicate(self.mesh, self.bins_fm)
+            self.scores = mesh_lib.replicate(self.mesh, self.scores)
+            self._sample_mask = mesh_lib.replicate(self.mesh,
+                                                   self._sample_mask)
+            self.feature_meta = jax.tree_util.tree_map(
+                lambda a: mesh_lib.replicate(self.mesh, a),
+                self.feature_meta)
+            from .feature_parallel import make_sharded_feature_grow
+            grow = make_sharded_feature_grow(self.mesh, hist_impl="xla",
+                                             **self._static)
+
+            def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
+                              forced=None):
+                return grow(bins, g, h, m, fm, meta, hp, md)
+            self._grow = _grow_adapter
+            self._fused = None
+
+    def _fast_path_ok(self, custom_grad) -> bool:
+        return False
+
+    @property
+    def num_machines(self) -> int:
+        return self.mesh.size
+
+
 class DataParallelDART(_DataParallelMixin, DART):
     def __init__(self, config, train_set, objective=None, num_shards: int = 0):
         super().__init__(config, train_set, objective)
@@ -81,13 +154,20 @@ class DataParallelRF(_DataParallelMixin, RF):
 def create_parallel_boosting(config: Config, train_set: BinnedDataset,
                              objective: Optional[ObjectiveFunction] = None
                              ) -> GBDT:
-    """Factory for distributed training (tree_learner=data/voting/feature).
-
-    All three reference strategies map onto the sharded-rows program (see
-    module docstring); `feature`-parallel additionally benefits from
-    feature-axis sharding, planned as a 2-D mesh extension.
+    """Factory for distributed training, dispatching the three reference
+    strategies (ref: tree_learner.cpp:17 CreateTreeLearner):
+      data    — rows sharded, GSPMD auto-partitioned histogram psum
+      voting  — rows sharded, PV-tree top-k vote + candidate-only psum
+      feature — data replicated, feature-slice compute + split all_gather
+    DART/RF boosting run on the data-parallel program.
     """
     num_shards = int(config.tpu_num_shards or 0)
+    if config.boosting == "gbdt" and config.tree_learner == "voting":
+        return VotingParallelGBDT(config, train_set, objective,
+                                  num_shards=num_shards)
+    if config.boosting == "gbdt" and config.tree_learner == "feature":
+        return FeatureParallelGBDT(config, train_set, objective,
+                                   num_shards=num_shards)
     cls = {"gbdt": DataParallelGBDT, "dart": DataParallelDART,
            "rf": DataParallelRF}[config.boosting]
     return cls(config, train_set, objective, num_shards=num_shards)
