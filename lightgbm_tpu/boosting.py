@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from .config import Config
 from .dataset import BinnedDataset
-from .learner import grow_tree, replay_tree
+from .learner import grow_tree, grow_tree_waved, replay_tree
 from .objectives import ObjectiveFunction, create_objective
 from .ops import histogram as hist_ops
 from .ops.split import FeatureMeta, SplitHyperParams, leaf_output
@@ -240,13 +240,31 @@ class GBDT:
 
     def _build_grow(self, hist_impl: str) -> None:
         self._hist_impl = hist_impl
+        self._has_categorical = any(
+            m.is_categorical for m in self.train_set.mappers)
         self._grow = jax.jit(functools.partial(
-            grow_tree, **self._static, hist_dtype=jnp.float32,
-            hist_impl=hist_impl,
-            interaction_groups=self._interaction_groups))
+            self._grow_fn(), **self._grow_kwargs(),
+            hist_dtype=jnp.float32, hist_impl=hist_impl,
+            interaction_groups=self._interaction_groups,
+            has_categorical=self._has_categorical))
         self._fused = None
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
+
+    def _use_waved(self) -> bool:
+        """Waved growth batches histogram builds of many splits into one
+        multi-leaf pass (learner.grow_tree_waved); forced splits need the
+        exact per-split grower."""
+        return self.config.tpu_wave_max > 0 and self._forced is None
+
+    def _grow_fn(self):
+        return grow_tree_waved if self._use_waved() else grow_tree
+
+    def _grow_kwargs(self):
+        kw = dict(self._static)
+        if self._use_waved():
+            kw["wave_max"] = int(self.config.tpu_wave_max)
+        return kw
 
     # ------------------------------------------------------------------
     # fast path: one fused XLA program per iteration, zero host round-trips
@@ -374,65 +392,85 @@ class GBDT:
         thr = jnp.sort(u)[k - 1]
         return u <= thr
 
+    def _obj_state(self):
+        return (self.objective.device_state()
+                if self.objective is not None else {"arrays": {}, "sub": {}})
+
     def _make_fused(self):
-        num_valid = len(self._valid_bins)
-        grow = functools.partial(grow_tree, **self._static,
+        """Build the one-XLA-program-per-iteration jit. All N-sized device
+        buffers (bin tensor, valid bins, objective label/weight/pad arrays)
+        are explicit arguments — closure capture would bake them into the
+        HLO as multi-hundred-MB literal constants and overflow compilation
+        at Higgs scale."""
+        grow = functools.partial(self._grow_fn(), **self._grow_kwargs(),
                                  hist_dtype=jnp.float32,
                                  hist_impl=self._hist_impl,
-                                 interaction_groups=self._interaction_groups)
+                                 interaction_groups=self._interaction_groups,
+                                 has_categorical=self._has_categorical)
         goss = self.config.data_sample_strategy == "goss"
 
-        def fused(scores, sample_mask, valid_scores, it, lr):
-            key = jax.random.fold_in(self._bagging_key, it)
-            sample_mask = self._sampling_in_jit(
-                jax.random.fold_in(key, 1), it, sample_mask)
-            grad_all, hess_all = self._grad_fn(scores)
-            recs = []
-            new_valid = list(valid_scores)
-            for k in range(self.num_tree_per_iteration):
-                grad, hess = grad_all[k], hess_all[k]
-                mask = sample_mask
-                if goss:
-                    mask, scale = self._goss_in_jit(
-                        jax.random.fold_in(key, 100 + k), grad, hess)
-                    grad, hess = grad * scale, hess * scale
-                true_grad, true_hess = grad, hess
-                if self.config.use_quantized_grad:
-                    grad, hess = self._discretize_in_jit(
-                        jax.random.fold_in(key, 300 + k), grad, hess)
-                fmask = self._feature_mask_in_jit(
-                    jax.random.fold_in(key, 200 + k))
-                rec, row_leaf = grow(self.bins_fm, grad, hess, mask, fmask,
-                                     self.feature_meta, self.hp,
-                                     self.max_depth, self._forced)
-                if self.config.use_quantized_grad and \
-                        self.config.quant_train_renew_leaf:
-                    rec = self._renew_leaves_in_jit(
-                        rec, row_leaf, true_grad, true_hess, mask)
-                # 1-leaf trees contribute nothing (the reference stops
-                # training instead, gbdt.cpp should_continue)
-                leaf_vals = jnp.where(rec.num_leaves > 1,
-                                      rec.leaf_value * lr, 0.0)
-                scores = scores.at[k].add(leaf_vals[row_leaf])
-                for vi in range(num_valid):
-                    vleaf = replay_tree(rec, self._valid_bins[vi],
-                                        self.feature_meta)
-                    new_valid[vi] = new_valid[vi].at[k].add(leaf_vals[vleaf])
-                recs.append(rec)
-            if len(recs) == 1:
-                stacked = jax.tree_util.tree_map(lambda x: x[None], recs[0])
-            else:
-                stacked = jax.tree_util.tree_map(
-                    lambda *xs: jnp.stack(xs), *recs)
-            return scores, sample_mask, tuple(new_valid), stacked
+        def fused(bins_fm, valid_bins, obj_state, scores, sample_mask,
+                  valid_scores, it, lr):
+            obj = self.objective
+            old_state = (obj.swap_device_state(obj_state)
+                         if obj is not None else None)
+            try:
+                key = jax.random.fold_in(self._bagging_key, it)
+                sample_mask = self._sampling_in_jit(
+                    jax.random.fold_in(key, 1), it, sample_mask)
+                grad_all, hess_all = self._grad_fn(scores)
+                recs = []
+                new_valid = list(valid_scores)
+                for k in range(self.num_tree_per_iteration):
+                    grad, hess = grad_all[k], hess_all[k]
+                    mask = sample_mask
+                    if goss:
+                        mask, scale = self._goss_in_jit(
+                            jax.random.fold_in(key, 100 + k), grad, hess)
+                        grad, hess = grad * scale, hess * scale
+                    true_grad, true_hess = grad, hess
+                    if self.config.use_quantized_grad:
+                        grad, hess = self._discretize_in_jit(
+                            jax.random.fold_in(key, 300 + k), grad, hess)
+                    fmask = self._feature_mask_in_jit(
+                        jax.random.fold_in(key, 200 + k))
+                    rec, row_leaf = grow(bins_fm, grad, hess, mask, fmask,
+                                         self.feature_meta, self.hp,
+                                         self.max_depth, self._forced)
+                    if self.config.use_quantized_grad and \
+                            self.config.quant_train_renew_leaf:
+                        rec = self._renew_leaves_in_jit(
+                            rec, row_leaf, true_grad, true_hess, mask)
+                    # 1-leaf trees contribute nothing (the reference stops
+                    # training instead, gbdt.cpp should_continue)
+                    leaf_vals = jnp.where(rec.num_leaves > 1,
+                                          rec.leaf_value * lr, 0.0)
+                    scores = scores.at[k].add(leaf_vals[row_leaf])
+                    for vi in range(len(valid_bins)):
+                        vleaf = replay_tree(rec, valid_bins[vi],
+                                            self.feature_meta)
+                        new_valid[vi] = new_valid[vi].at[k].add(
+                            leaf_vals[vleaf])
+                    recs.append(rec)
+                if len(recs) == 1:
+                    stacked = jax.tree_util.tree_map(
+                        lambda x: x[None], recs[0])
+                else:
+                    stacked = jax.tree_util.tree_map(
+                        lambda *xs: jnp.stack(xs), *recs)
+                return scores, sample_mask, tuple(new_valid), stacked
+            finally:
+                if obj is not None:
+                    obj.swap_device_state(old_state)
 
-        return jax.jit(fused, donate_argnums=(0, 1, 2))
+        return jax.jit(fused, donate_argnums=(3, 4, 5))
 
     def _train_one_iter_fast(self) -> bool:
         self._boost_from_average()
         if self._fused is None:
             self._fused = self._make_fused()
         self.scores, self._sample_mask, valid, recs = self._fused(
+            self.bins_fm, tuple(self._valid_bins), self._obj_state(),
             self.scores, self._sample_mask, tuple(self._valid_scores),
             jnp.int32(self.iter), jnp.float32(self.shrinkage_rate))
         self._valid_scores = list(valid)
@@ -737,6 +775,22 @@ class GBDT:
         done = np.zeros(n, bool)
         num_bins, missing, default_bin, is_cat = \
             self.train_set.feature_meta_arrays()
+        # bin-level go-left lookup for categorical nodes: mapper bin ->
+        # raw category value -> membership in the node's value bitset
+        max_b = int(self.train_set.max_bins)
+        cat_lut = np.zeros((tree.num_internal, max_b), bool)
+        for nd_i in range(tree.num_internal):
+            if not (tree.decision_type[nd_i] & 1):
+                continue
+            mapper = self.train_set.mappers[tree.split_feature_inner[nd_i]]
+            cat_idx = int(tree.threshold[nd_i])
+            lo, hi = (tree.cat_boundaries[cat_idx],
+                      tree.cat_boundaries[cat_idx + 1])
+            for b in range(1, mapper.num_bins):
+                v = int(mapper.bin_to_value(b))
+                if v >= 0 and v // 32 < hi - lo and \
+                        (tree.cat_threshold[lo + v // 32] >> (v % 32)) & 1:
+                    cat_lut[nd_i, b] = True
         for _ in range(tree.num_internal + 1):
             if done.all():
                 break
@@ -749,7 +803,7 @@ class GBDT:
             is_nan = (missing[feat] == 2) & (b == nan_bin)
             dleft = (tree.decision_type[nd] & 2) > 0
             cat = (tree.decision_type[nd] & 1) > 0
-            go_left = np.where(cat, b == tbin,
+            go_left = np.where(cat, cat_lut[nd, b],
                                np.where(is_nan, dleft, b <= tbin))
             child = np.where(go_left, tree.left_child[nd],
                              tree.right_child[nd])
@@ -761,14 +815,39 @@ class GBDT:
 
     # ------------------------------------------------------------------
     # prediction (ref: gbdt_prediction.cpp:16-91, predictor.hpp:31)
+    # Default path: packed device ensemble traversal (ops/predict.py) —
+    # one XLA program over [T] trees x [B] rows; host fallback for linear
+    # trees (per-leaf models live on host).
+    _PREDICT_CHUNK = 1 << 20
+
     def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1) -> np.ndarray:
         data = np.asarray(data, np.float64)
+        end = len(self.models) if num_iteration < 0 else \
+            min(len(self.models), start_iteration + num_iteration)
+        trees = [t for it in self.models[start_iteration:end] for t in it]
+        if not trees:
+            return np.zeros((data.shape[0], self.num_tree_per_iteration))
+        if any(t.is_linear for t in trees):
+            return self._predict_raw_host(data, start_iteration, end)
+        from .ops.predict import pack_ensemble, predict_raw_multiclass
+        key = (start_iteration, end, self.current_iteration())
+        if getattr(self, "_packed_key", None) != key:
+            self._packed = pack_ensemble(trees, self.num_tree_per_iteration)
+            self._packed_key = key
+        n = data.shape[0]
+        outs = []
+        for lo in range(0, n, self._PREDICT_CHUNK):
+            x = jnp.asarray(data[lo:lo + self._PREDICT_CHUNK], jnp.float32)
+            outs.append(np.asarray(
+                predict_raw_multiclass(self._packed, x), np.float64))
+        return np.concatenate(outs, axis=0)
+
+    def _predict_raw_host(self, data: np.ndarray, start_iteration: int,
+                          end: int) -> np.ndarray:
         n = data.shape[0]
         k = self.num_tree_per_iteration
         out = np.zeros((n, k))
-        end = len(self.models) if num_iteration < 0 else \
-            min(len(self.models), start_iteration + num_iteration)
         for it in range(start_iteration, end):
             for ki, tree in enumerate(self.models[it]):
                 out[:, ki] += tree.predict(data)

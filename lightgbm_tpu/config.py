@@ -168,6 +168,7 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_hist_dtype": [],
     "tpu_num_shards": [],
     "tpu_donate_buffers": [],
+    "tpu_wave_max": [],
 }
 
 _ALIAS_TO_CANONICAL: Dict[str, str] = {}
@@ -422,6 +423,10 @@ class Config:
     tpu_hist_dtype: str = "float32"
     tpu_num_shards: int = 0  # 0 = use all local devices for data-parallel learner
     tpu_donate_buffers: bool = True
+    # waved leaf-wise growth: batch histogram builds of up to this many
+    # splits into one multi-leaf pass (0 = exact per-split builds; the
+    # early waves are exact either way — see learner.grow_tree_waved)
+    tpu_wave_max: int = 0
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)

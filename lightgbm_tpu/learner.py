@@ -46,6 +46,7 @@ class TreeArrays(NamedTuple):
     split_bin_threshold: jax.Array  # [L-1] int32
     split_default_left: jax.Array  # [L-1] bool
     split_gain: jax.Array          # [L-1] f32
+    split_cat_mask: jax.Array      # [L-1, B] bool (bins going left, cat)
     internal_value: jax.Array      # [L-1] f32 (unshrunk output of split node)
     internal_weight: jax.Array     # [L-1] f32 (sum_hess)
     internal_count: jax.Array      # [L-1] f32
@@ -57,7 +58,9 @@ class TreeArrays(NamedTuple):
 
 class _LeafSplits(NamedTuple):
     """Per-leaf stats + stored best split (ref: leaf_splits.hpp:23 +
-    best_split_per_leaf_ in serial_tree_learner.h)."""
+    best_split_per_leaf_ in serial_tree_learner.h). min/max_bound are the
+    leaf's output bounds inherited from ancestor monotone splits
+    (ref: monotone_constraints.hpp:466 BasicLeafConstraints entries)."""
     sum_grad: jax.Array   # [L]
     sum_hess: jax.Array   # [L]
     count: jax.Array      # [L]
@@ -70,6 +73,11 @@ class _LeafSplits(NamedTuple):
     left_sum_grad: jax.Array
     left_sum_hess: jax.Array
     left_count: jax.Array
+    left_output: jax.Array   # [L] candidate left-child output
+    right_output: jax.Array  # [L] candidate right-child output
+    cat_mask: jax.Array      # [L, B] bool candidate categorical mask
+    min_bound: jax.Array     # [L] monotone lower output bound
+    max_bound: jax.Array     # [L] monotone upper output bound
 
 
 class _GrowState(NamedTuple):
@@ -80,7 +88,8 @@ class _GrowState(NamedTuple):
 
 
 def _store_split(leaves: _LeafSplits, idx, info: SplitInfo, depth, output,
-                 sum_grad, sum_hess, count, valid) -> _LeafSplits:
+                 sum_grad, sum_hess, count, min_bound, max_bound,
+                 valid) -> _LeafSplits:
     """Write one leaf's stats + its best candidate split at slot `idx`."""
     def upd(arr, val):
         return arr.at[idx].set(jnp.where(valid, val, arr[idx]))
@@ -97,6 +106,11 @@ def _store_split(leaves: _LeafSplits, idx, info: SplitInfo, depth, output,
         left_sum_grad=upd(leaves.left_sum_grad, info.left_sum_grad),
         left_sum_hess=upd(leaves.left_sum_hess, info.left_sum_hess),
         left_count=upd(leaves.left_count, info.left_count),
+        left_output=upd(leaves.left_output, info.left_output),
+        right_output=upd(leaves.right_output, info.right_output),
+        cat_mask=upd(leaves.cat_mask, info.cat_mask),
+        min_bound=upd(leaves.min_bound, min_bound),
+        max_bound=upd(leaves.max_bound, max_bound),
     )
 
 
@@ -124,7 +138,8 @@ def grow_tree(bins_fm: jax.Array,
               hist_dtype=jnp.float32,
               row_chunk: int = 0,
               hist_impl: str = "xla",
-              interaction_groups=None):
+              interaction_groups=None,
+              has_categorical: bool = True):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
 
     sample_mask: [N] float {0,1} bagging/GOSS selection (excluded rows still
@@ -158,8 +173,11 @@ def grow_tree(bins_fm: jax.Array,
     root_out = leaf_output(root_g, root_h, hp)
     root_fmask = feature_mask if root_allowed is None else \
         feature_mask & root_allowed
+    neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
     root_split = find_best_split(root_hist, root_g, root_h, root_c,
-                                 meta, hp, root_fmask, root_out)
+                                 meta, hp, root_fmask, root_out,
+                                 neg_inf, pos_inf, jnp.int32(0),
+                                 has_categorical)
 
     zero_l = jnp.zeros((L,), f32)
     leaves = _LeafSplits(
@@ -171,9 +189,13 @@ def grow_tree(bins_fm: jax.Array,
         threshold=jnp.zeros((L,), jnp.int32),
         default_left=jnp.zeros((L,), jnp.bool_),
         left_sum_grad=zero_l, left_sum_hess=zero_l, left_count=zero_l,
+        left_output=zero_l, right_output=zero_l,
+        cat_mask=jnp.zeros((L, max_bins), jnp.bool_),
+        min_bound=jnp.full((L,), -jnp.inf, f32),
+        max_bound=jnp.full((L,), jnp.inf, f32),
     )
     leaves = _store_split(leaves, 0, root_split, jnp.int32(1), root_out,
-                          root_g, root_h, root_c, True)
+                          root_g, root_h, root_c, neg_inf, pos_inf, True)
 
     pool = jnp.zeros((L, num_features, max_bins, hist_ops.NUM_HIST_CHANNELS),
                      f32)
@@ -228,6 +250,9 @@ def grow_tree(bins_fm: jax.Array,
             & (meta.default_bin[feat] <= thr)
         dleft = jnp.where(use_forced, forced_dleft,
                           leaves.default_left[best_leaf])
+        cat_mask = jnp.where(use_forced,
+                             jnp.zeros_like(leaves.cat_mask[0]),
+                             leaves.cat_mask[best_leaf])
 
         # --- children stats: stored candidate, or the forced gather
         pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
@@ -242,7 +267,8 @@ def grow_tree(bins_fm: jax.Array,
         # --- partition rows (left keeps best_leaf id, right -> new_leaf)
         row_leaf = part_ops.apply_split(
             state.row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
-            meta.num_bins, meta.missing_type, meta.is_categorical, valid)
+            cat_mask, meta.num_bins, meta.missing_type, meta.is_categorical,
+            valid)
 
         # --- histograms: build smaller child, subtract for the sibling
         # (ref: serial_tree_learner.cpp:373-386,582)
@@ -260,10 +286,21 @@ def grow_tree(bins_fm: jax.Array,
         pool = pool.at[new_leaf].set(
             jnp.where(valid, right_hist, pool[new_leaf]))
 
-        # --- child outputs (path-smoothed toward the parent's output)
+        # --- child outputs: the stored candidate's (clamped, with the
+        # categorical l2 where applicable), or recomputed for forced splits
         parent_out = leaves.output[best_leaf]
-        out_l = leaf_output_smooth(lg, lh, lc, parent_out, hp)
-        out_r = leaf_output_smooth(rg, rh, rc, parent_out, hp)
+        p_minb = leaves.min_bound[best_leaf]
+        p_maxb = leaves.max_bound[best_leaf]
+        f_out_l_c = jnp.clip(f_out_l, p_minb, p_maxb)
+        f_out_r_c = jnp.clip(f_out_r, p_minb, p_maxb)
+        out_l = jnp.where(use_forced, f_out_l_c,
+                          leaves.left_output[best_leaf])
+        out_r = jnp.where(use_forced, f_out_r_c,
+                          leaves.right_output[best_leaf])
+
+        l_min, l_max, r_min, r_max = split_ops.propagate_monotone_bounds(
+            out_l, out_r, meta.monotone[feat].astype(jnp.int32),
+            meta.is_categorical[feat], p_minb, p_maxb)
 
         # --- per-child allowed features (interaction constraints)
         used_features = state.used_features
@@ -280,10 +317,13 @@ def grow_tree(bins_fm: jax.Array,
 
         # --- find child best splits
         child_depth = leaves.depth[best_leaf] + 1
+        pen_depth = child_depth - 1  # reference depth of the child leaf
         split_l = find_best_split(left_hist, lg, lh, lc, meta, hp,
-                                  child_fmask, out_l)
+                                  child_fmask, out_l, l_min, l_max,
+                                  pen_depth, has_categorical)
         split_r = find_best_split(right_hist, rg, rh, rc, meta, hp,
-                                  child_fmask, out_r)
+                                  child_fmask, out_r, r_min, r_max,
+                                  pen_depth, has_categorical)
         # depth cap (ref: serial_tree_learner.cpp max_depth check)
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         split_l = split_l._replace(
@@ -296,9 +336,9 @@ def grow_tree(bins_fm: jax.Array,
         chosen_gain = jnp.where(use_forced, f_gain, leaves.gain[best_leaf])
 
         leaves = _store_split(leaves, best_leaf, split_l, child_depth, out_l,
-                              lg, lh, lc, valid)
+                              lg, lh, lc, l_min, l_max, valid)
         leaves = _store_split(leaves, new_leaf, split_r, child_depth, out_r,
-                              rg, rh, rc, valid)
+                              rg, rh, rc, r_min, r_max, valid)
 
         record = dict(
             split_leaf=jnp.where(valid, best_leaf, -1),
@@ -306,6 +346,7 @@ def grow_tree(bins_fm: jax.Array,
             split_bin_threshold=thr,
             split_default_left=dleft,
             split_gain=jnp.where(valid, chosen_gain, 0.0),
+            split_cat_mask=cat_mask,
             internal_value=parent_out,
             internal_weight=ph,
             internal_count=pc,
@@ -327,6 +368,7 @@ def grow_tree(bins_fm: jax.Array,
         split_bin_threshold=records["split_bin_threshold"],
         split_default_left=records["split_default_left"],
         split_gain=records["split_gain"],
+        split_cat_mask=records["split_cat_mask"],
         internal_value=records["internal_value"],
         internal_weight=records["internal_weight"],
         internal_count=records["internal_count"],
@@ -336,6 +378,284 @@ def grow_tree(bins_fm: jax.Array,
         num_leaves=num_leaves_out,
     )
     return tree_arrays, state.row_leaf
+
+
+def _wave_schedule(num_leaves: int, wave_max: int, slots: int):
+    """Static split-batch sizes: 1, 1, 2, 4, ... doubling, capped at
+    min(wave_max, slots), summing to num_leaves - 1. Early waves are
+    exact leaf-wise (the high-impact splits); later waves amortize one
+    multi-leaf histogram pass over up to `slots` splits."""
+    sizes, total, w = [], num_leaves - 1, 1
+    while total > 0:
+        s = min(w, total, max(wave_max, 1), slots)
+        sizes.append(s)
+        total -= s
+        w *= 2
+    return sizes
+
+
+def grow_tree_waved(bins_fm: jax.Array,
+                    grad: jax.Array,
+                    hess: jax.Array,
+                    sample_mask: jax.Array,
+                    feature_mask: jax.Array,
+                    meta: FeatureMeta,
+                    hp: SplitHyperParams,
+                    max_depth: jax.Array,
+                    forced: Optional[tuple] = None,
+                    *,
+                    num_leaves: int,
+                    max_bins: int,
+                    hist_dtype=jnp.float32,
+                    hist_impl: str = "xla",
+                    interaction_groups=None,
+                    has_categorical: bool = True,
+                    wave_max: int = 32):
+    """Leaf-wise growth with waved (batched) histogram construction.
+
+    Identical split mathematics to `grow_tree`, but histogram builds are
+    batched: splits are applied in waves; at each wave boundary ONE
+    multi-leaf pass (ops/pallas_histogram.hist_multi) builds the smaller
+    children of all the wave's splits simultaneously, and siblings come
+    from subtraction. This turns the reference's per-leaf histogram
+    kernels (cuda_histogram_constructor.cu:21 — one launch per leaf,
+    touching that leaf's rows) into ~log2(num_leaves)+L/slots full-data
+    passes — the shape the TPU MXU wants.
+
+    Semantics vs exact leaf-wise: within a wave, freshly-created children
+    are not yet split candidates (their histograms arrive at the wave
+    boundary). Wave sizes grow geometrically from 1, so the early,
+    high-impact splits are chosen exactly as in `grow_tree`.
+
+    Forced splits are not supported (the caller falls back to
+    `grow_tree`).
+    """
+    assert forced is None, "waved growth does not support forced splits"
+    from .ops.pallas_histogram import hist_multi
+
+    num_data = bins_fm.shape[1]
+    num_features = bins_fm.shape[0]
+    L = num_leaves
+    f32 = hist_dtype
+    SLOTS = 42  # 128 MXU columns // 3 channels
+
+    build = functools.partial(hist_ops.build_histogram, max_bins=max_bins,
+                              dtype=f32, row_chunk=0, impl=hist_impl)
+    ghT = jnp.stack([grad * sample_mask, hess * sample_mask, sample_mask],
+                    axis=1).astype(jnp.float32)
+
+    if interaction_groups is not None:
+        interaction_groups = jnp.asarray(interaction_groups, bool)
+        root_allowed = jnp.any(interaction_groups, axis=0)
+    else:
+        root_allowed = None
+
+    # --- root
+    root_hist = build(bins_fm, grad, hess, sample_mask)
+    root_g = jnp.sum(grad * sample_mask, dtype=f32)
+    root_h = jnp.sum(hess * sample_mask, dtype=f32)
+    root_c = jnp.sum(sample_mask, dtype=f32)
+    root_out = leaf_output(root_g, root_h, hp)
+    root_fmask = feature_mask if root_allowed is None else \
+        feature_mask & root_allowed
+    neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
+    root_split = find_best_split(root_hist, root_g, root_h, root_c,
+                                 meta, hp, root_fmask, root_out,
+                                 neg_inf, pos_inf, jnp.int32(0),
+                                 has_categorical)
+
+    zero_l = jnp.zeros((L,), f32)
+    leaves = _LeafSplits(
+        sum_grad=zero_l, sum_hess=zero_l, count=zero_l,
+        depth=jnp.zeros((L,), jnp.int32),
+        output=zero_l,
+        gain=jnp.full((L,), K_MIN_SCORE, f32),
+        feature=jnp.zeros((L,), jnp.int32),
+        threshold=jnp.zeros((L,), jnp.int32),
+        default_left=jnp.zeros((L,), jnp.bool_),
+        left_sum_grad=zero_l, left_sum_hess=zero_l, left_count=zero_l,
+        left_output=zero_l, right_output=zero_l,
+        cat_mask=jnp.zeros((L, max_bins), jnp.bool_),
+        min_bound=jnp.full((L,), -jnp.inf, f32),
+        max_bound=jnp.full((L,), jnp.inf, f32),
+    )
+    leaves = _store_split(leaves, 0, root_split, jnp.int32(1), root_out,
+                          root_g, root_h, root_c, neg_inf, pos_inf, True)
+
+    pool = jnp.zeros((L, num_features, max_bins, hist_ops.NUM_HIST_CHANNELS),
+                     f32)
+    pool = pool.at[0].set(root_hist)
+    row_leaf = jnp.zeros((num_data,), jnp.int32)
+    used_features = (jnp.zeros((L, num_features), bool)
+                     if interaction_groups is not None else None)
+
+    unknown = SplitInfo(
+        gain=jnp.float32(K_MIN_SCORE), feature=jnp.int32(0),
+        threshold=jnp.int32(0), default_left=jnp.bool_(False),
+        left_sum_grad=jnp.float32(0), left_sum_hess=jnp.float32(0),
+        left_count=jnp.float32(0), right_sum_grad=jnp.float32(0),
+        right_sum_hess=jnp.float32(0), right_count=jnp.float32(0),
+        left_output=jnp.float32(0), right_output=jnp.float32(0),
+        cat_mask=jnp.zeros((max_bins,), jnp.bool_))
+
+    def wave_step(carry, step_idx):
+        """Apply one split using STORED candidates only (no histograms)."""
+        row_leaf, leaves, used = carry
+        new_leaf = (step_idx + 1).astype(jnp.int32)
+        best_leaf = jnp.argmax(leaves.gain).astype(jnp.int32)
+        valid = leaves.gain[best_leaf] > 0.0
+        feat = leaves.feature[best_leaf]
+        thr = leaves.threshold[best_leaf]
+        dleft = leaves.default_left[best_leaf]
+        cmask = leaves.cat_mask[best_leaf]
+
+        row_leaf = part_ops.apply_split(
+            row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft, cmask,
+            meta.num_bins, meta.missing_type, meta.is_categorical, valid)
+
+        pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
+                      leaves.count[best_leaf])
+        lg = leaves.left_sum_grad[best_leaf]
+        lh = leaves.left_sum_hess[best_leaf]
+        lc = leaves.left_count[best_leaf]
+        rg, rh, rc = pg - lg, ph - lh, pc - lc
+        parent_out = leaves.output[best_leaf]
+        p_minb = leaves.min_bound[best_leaf]
+        p_maxb = leaves.max_bound[best_leaf]
+        out_l = leaves.left_output[best_leaf]
+        out_r = leaves.right_output[best_leaf]
+        chosen_gain = leaves.gain[best_leaf]
+
+        l_min, l_max, r_min, r_max = split_ops.propagate_monotone_bounds(
+            out_l, out_r, meta.monotone[feat].astype(jnp.int32),
+            meta.is_categorical[feat], p_minb, p_maxb)
+
+        if used is not None:
+            child_used = used[best_leaf].at[feat].set(True)
+            used = used.at[best_leaf].set(
+                jnp.where(valid, child_used, used[best_leaf]))
+            used = used.at[new_leaf].set(
+                jnp.where(valid, child_used, used[new_leaf]))
+
+        child_depth = leaves.depth[best_leaf] + 1
+        # children have no candidates until the wave-boundary build
+        leaves = _store_split(leaves, best_leaf, unknown, child_depth,
+                              out_l, lg, lh, lc, l_min, l_max, valid)
+        leaves = _store_split(leaves, new_leaf, unknown, child_depth,
+                              out_r, rg, rh, rc, r_min, r_max, valid)
+
+        left_smaller = lc <= rc
+        record = dict(
+            split_leaf=jnp.where(valid, best_leaf, -1),
+            split_feature=feat,
+            split_bin_threshold=thr,
+            split_default_left=dleft,
+            split_gain=jnp.where(valid, chosen_gain, 0.0),
+            split_cat_mask=cmask,
+            internal_value=parent_out,
+            internal_weight=ph,
+            internal_count=pc,
+        )
+        ys = dict(record=record, valid=valid,
+                  left_id=best_leaf, right_id=new_leaf,
+                  small_id=jnp.where(left_smaller, best_leaf, new_leaf),
+                  left_smaller=left_smaller)
+        return (row_leaf, leaves, used), ys
+
+    def child_candidates(hist, cid, fmask_c, leaves):
+        """find_best_split for one child from its stored stats."""
+        return find_best_split(
+            hist, leaves.sum_grad[cid], leaves.sum_hess[cid],
+            leaves.count[cid], meta, hp, fmask_c, leaves.output[cid],
+            leaves.min_bound[cid], leaves.max_bound[cid],
+            leaves.depth[cid] - 1, has_categorical)
+
+    all_records = []
+    s0 = 0
+    for W in _wave_schedule(L, wave_max, SLOTS):
+        (row_leaf, leaves, used_features), ys = lax.scan(
+            wave_step, (row_leaf, leaves, used_features),
+            jnp.arange(s0, s0 + W, dtype=jnp.int32))
+        all_records.append(ys["record"])
+        s0 += W
+
+        # --- wave boundary: ONE multi-leaf pass builds all the wave's
+        # smaller children; siblings come from subtraction
+        # (ref: serial_tree_learner.cpp:582 histogram subtraction)
+        small_ids = jnp.where(ys["valid"], ys["small_id"], -2)
+        pad = SLOTS - W
+        ids_padded = jnp.pad(small_ids, (0, pad), constant_values=-2) \
+            if pad > 0 else small_ids
+        smalls = hist_multi(bins_fm, ghT, row_leaf, ids_padded,
+                            max_bins=max_bins, num_slots=SLOTS,
+                            impl=hist_impl)  # [SLOTS, F, B, 3]
+        for i in range(W):
+            valid = ys["valid"][i]
+            left_id, right_id = ys["left_id"][i], ys["right_id"][i]
+            parent_hist = pool[left_id]
+            small_h = smalls[i].astype(f32)
+            large_h = hist_ops.subtract_histogram(parent_hist, small_h)
+            left_h = jnp.where(ys["left_smaller"][i], small_h, large_h)
+            right_h = jnp.where(ys["left_smaller"][i], large_h, small_h)
+            pool = pool.at[left_id].set(
+                jnp.where(valid, left_h, parent_hist))
+            pool = pool.at[right_id].set(
+                jnp.where(valid, right_h, pool[right_id]))
+
+        # --- candidates for the 2W children, batched
+        child_ids = jnp.concatenate([ys["left_id"], ys["right_id"]])
+        child_valid = jnp.concatenate([ys["valid"], ys["valid"]])
+        hists = pool[child_ids]
+        if used_features is not None:
+            fmask_c = feature_mask[None, :] & jax.vmap(
+                _allowed_features, in_axes=(0, None))(
+                    used_features[child_ids], interaction_groups)
+        else:
+            fmask_c = jnp.broadcast_to(feature_mask, (2 * W, num_features))
+        infos = jax.vmap(child_candidates, in_axes=(0, 0, 0, None))(
+            hists, child_ids, fmask_c, leaves)
+        depth_ok = (max_depth <= 0) | (leaves.depth[child_ids] < max_depth)
+        gains = jnp.where(child_valid & depth_ok, infos.gain, K_MIN_SCORE)
+
+        def upd(arr, val):
+            keep = arr[child_ids]
+            return arr.at[child_ids].set(
+                jnp.where(child_valid.reshape(
+                    (-1,) + (1,) * (val.ndim - 1)), val, keep))
+        leaves = leaves._replace(
+            gain=leaves.gain.at[child_ids].set(
+                jnp.where(child_valid, gains, leaves.gain[child_ids])),
+            feature=upd(leaves.feature, infos.feature),
+            threshold=upd(leaves.threshold, infos.threshold),
+            default_left=upd(leaves.default_left, infos.default_left),
+            left_sum_grad=upd(leaves.left_sum_grad, infos.left_sum_grad),
+            left_sum_hess=upd(leaves.left_sum_hess, infos.left_sum_hess),
+            left_count=upd(leaves.left_count, infos.left_count),
+            left_output=upd(leaves.left_output, infos.left_output),
+            right_output=upd(leaves.right_output, infos.right_output),
+            cat_mask=upd(leaves.cat_mask, infos.cat_mask),
+        )
+
+    records = jax.tree_util.tree_map(
+        lambda *xs: jnp.concatenate(xs, axis=0), *all_records)
+    num_leaves_out = 1 + jnp.sum(records["split_leaf"] >= 0).astype(jnp.int32)
+
+    tree_arrays = TreeArrays(
+        split_leaf=records["split_leaf"],
+        split_feature=records["split_feature"],
+        split_bin_threshold=records["split_bin_threshold"],
+        split_default_left=records["split_default_left"],
+        split_gain=records["split_gain"],
+        split_cat_mask=records["split_cat_mask"],
+        internal_value=records["internal_value"],
+        internal_weight=records["internal_weight"],
+        internal_count=records["internal_count"],
+        leaf_value=leaves.output,
+        leaf_weight=leaves.sum_hess,
+        leaf_count=leaves.count,
+        num_leaves=num_leaves_out,
+    )
+    return tree_arrays, row_leaf
 
 
 def replay_tree(tree: TreeArrays, bins_fm: jax.Array,
@@ -348,9 +668,9 @@ def replay_tree(tree: TreeArrays, bins_fm: jax.Array,
     num_splits = tree.split_leaf.shape[0]
 
     def step(row_leaf, inputs):
-        step_idx, leaf, feat, thr, dleft = inputs
+        step_idx, leaf, feat, thr, dleft, cmask = inputs
         row_leaf = part_ops.apply_split(
-            row_leaf, bins_fm, leaf, step_idx + 1, feat, thr, dleft,
+            row_leaf, bins_fm, leaf, step_idx + 1, feat, thr, dleft, cmask,
             meta.num_bins, meta.missing_type, meta.is_categorical, leaf >= 0)
         return row_leaf, None
 
@@ -358,6 +678,6 @@ def replay_tree(tree: TreeArrays, bins_fm: jax.Array,
         step, jnp.zeros(num_data, jnp.int32),
         (jnp.arange(num_splits, dtype=jnp.int32), tree.split_leaf,
          tree.split_feature, tree.split_bin_threshold,
-         tree.split_default_left),
+         tree.split_default_left, tree.split_cat_mask),
         unroll=2 if num_splits > 1 else 1)
     return row_leaf

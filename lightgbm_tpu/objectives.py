@@ -53,6 +53,39 @@ class ObjectiveFunction:
     def get_gradients(self, score: jax.Array) -> Tuple[jax.Array, jax.Array]:
         raise NotImplementedError
 
+    # -- device-state plumbing ------------------------------------------
+    # N-sized device buffers (labels, weights, ranking pad layouts) must
+    # enter jitted programs as *arguments*, never as closed-over constants:
+    # closure capture bakes them into the HLO as literals, which at
+    # Higgs scale (10.5M rows) overflows the compile payload entirely
+    # (the reference never faces this: its objectives read raw pointers,
+    # objective_function.h GetGradients).
+    def device_state(self):
+        """Pytree of this objective's device-resident arrays (recursing
+        into sub-objectives), for passing as explicit jit arguments."""
+        arrays = {k: v for k, v in vars(self).items()
+                  if isinstance(v, jax.Array)}
+        sub = {}
+        for k, v in vars(self).items():
+            if isinstance(v, list) and v and all(
+                    isinstance(o, ObjectiveFunction) for o in v):
+                sub[k] = [o.device_state() for o in v]
+        return {"arrays": arrays, "sub": sub}
+
+    def swap_device_state(self, state):
+        """Install `state`'s arrays as attributes, returning the previous
+        state (call again with the return value to restore). Used inside
+        jit tracing so traced gradient code references argument tracers."""
+        old = {"arrays": {}, "sub": {}}
+        for k, v in state["arrays"].items():
+            old["arrays"][k] = getattr(self, k)
+            setattr(self, k, v)
+        for k, lst in state["sub"].items():
+            objs = getattr(self, k)
+            old["sub"][k] = [o.swap_device_state(s)
+                             for o, s in zip(objs, lst)]
+        return old
+
     @property
     def is_constant_hessian(self) -> bool:
         """(ref: ObjectiveFunction::IsConstantHessian — true when every
@@ -479,6 +512,11 @@ class LambdarankNDCG(_RankingObjective):
             inv_max_dcg[q] = 1.0 / dcg if dcg > 0 else 0.0
         self.inv_max_dcg = jnp.asarray(inv_max_dcg)
         self.trunc = trunc
+        # eager, not lazy: creating this inside a jit trace would leak a
+        # tracer into objective state
+        self._lab_pad_int = (jnp.asarray(self.label_np.astype(np.int32))
+                             [self.pad_idx] *
+                             self.pad_mask.astype(jnp.int32))
 
     def get_gradients(self, score):
         """Pairwise lambdarank over padded queries
@@ -524,10 +562,6 @@ class LambdarankNDCG(_RankingObjective):
         return self._scatter_back(grad_pad, hess_pad)
 
     def label_np_pad_int(self):
-        if not hasattr(self, "_lab_pad_int"):
-            self._lab_pad_int = (jnp.asarray(self.label_np.astype(np.int32))
-                                 [self.pad_idx] *
-                                 self.pad_mask.astype(jnp.int32))
         return self._lab_pad_int
 
 

@@ -17,6 +17,7 @@ output block (TPU grids execute sequentially, minor-dim fastest).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -44,6 +45,139 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, *, f_blk: int, max_bins: int,
         onehot = (b[:, None] == lax.broadcasted_iota(
             jnp.int32, (chunk, max_bins), 1)).astype(jnp.float32)
         out_ref[f, :, :] += jax.lax.dot(gh, onehot, precision=prec)
+
+
+def _multi_kernel(bins_ref, ghT_ref, rlT_ref, leafsel_ref, out_ref, *,
+                  f_blk: int, group: int, max_bins: int, precise: bool):
+    """One grid step: f_blk features' transposed one-hots ([group*B, R]
+    per dot, built in VMEM) x a shared [R, 128] leaf-selected gh operand
+    -> accumulate [f_blk*B, 128]."""
+    ch = pl.program_id(1)
+
+    @pl.when(ch == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    rl = rlT_ref[...]      # [R, 1] int32 row -> leaf
+    gh = ghT_ref[...]      # [R, 3] f32 (grad, hess, weight)
+    r = rl.shape[0]
+    lanes = lax.broadcasted_iota(jnp.int32, (r, 128), 1)
+    csel = lanes % 3
+    gsel = jnp.where(csel == 0, gh[:, 0:1],
+                     jnp.where(csel == 1, gh[:, 1:2], gh[:, 2:3]))
+    # leaf-block-diagonal gh operand: lane k = (leaf k//3, channel k%3)
+    bop = jnp.where(rl == leafsel_ref[...], gsel, 0.0)  # [R, 128]
+    prec = lax.Precision.HIGHEST if precise else lax.Precision.DEFAULT
+
+    rows = group * max_bins
+    riota = lax.broadcasted_iota(jnp.int32, (rows, r), 0)
+    for q in range(f_blk // group):
+        b_eff = jnp.zeros((rows, r), jnp.int32)
+        for p in range(group):
+            b_eff = jnp.where(
+                riota // max_bins == p,
+                bins_ref[q * group + p, :][None, :].astype(jnp.int32), b_eff)
+        onehot_t = (b_eff == riota % max_bins).astype(jnp.float32)
+        out_ref[0, q * rows:(q + 1) * rows, :] += jax.lax.dot(
+            onehot_t, bop, precision=prec)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_bins", "num_slots", "row_chunk",
+                                    "precise", "interpret"))
+def hist_pallas_multi(bins_fm: jax.Array, ghT: jax.Array, row_leaf: jax.Array,
+                      leaf_ids: jax.Array, *, max_bins: int, num_slots: int,
+                      row_chunk: int = 2048, precise: bool = True,
+                      interpret: bool = False) -> jax.Array:
+    """Histograms of up to `num_slots` leaves in ONE pass over the rows.
+
+    The one-hot (bins) operand is leaf-independent, so packing the MXU's
+    128 output columns with (leaf, channel) pairs builds J = 42 leaves'
+    histograms for the cost of one (the reference instead loops leaves,
+    touching each leaf's rows separately — cuda_histogram_constructor.cu:21
+    one kernel per leaf). Rows route to their leaf's columns via a
+    compare against row_leaf — the device analog of DataPartition.
+
+    bins_fm: [F, N] uint8/16; ghT: [N, 3] f32 pre-masked (grad, hess, w);
+    row_leaf: [N] int32; leaf_ids: [num_slots] int32 (pad with -2).
+    Returns hist [num_slots, F, B, 3] f32.
+    """
+    num_features, n = bins_fm.shape
+    assert num_slots * 3 <= 128, "num_slots capped at 42 by MXU columns"
+    group = max(1, 128 // max_bins) if max_bins <= 128 else 1
+    # bins tile first dim must be a multiple of 8 (Mosaic) AND of group
+    # (the kernel consumes features in groups of `group` per dot)
+    f_blk = group * 8 // math.gcd(group, 8)
+    pad_f = (-num_features) % f_blk
+    if pad_f:
+        bins_fm = jnp.pad(bins_fm, ((0, pad_f), (0, 0)),
+                          constant_values=0)
+    fp = bins_fm.shape[0]
+    pad_n = (-n) % row_chunk
+    if pad_n:
+        bins_fm = jnp.pad(bins_fm, ((0, 0), (0, pad_n)),
+                          constant_values=0)
+        ghT = jnp.pad(ghT, ((0, pad_n), (0, 0)))  # zero gh: no contribution
+        row_leaf = jnp.pad(row_leaf, (0, pad_n), constant_values=-1)
+    npad = bins_fm.shape[1]
+
+    # lane k holds leaf_ids[k//3]; lanes beyond 3*num_slots get sentinel -2
+    # (never equals a row_leaf entry, which is >= 0 or -1 padding)
+    k = jnp.arange(128)
+    leafsel = jnp.where(k < 3 * num_slots,
+                        leaf_ids[jnp.minimum(k // 3, num_slots - 1)],
+                        -2).astype(jnp.int32)[None, :]
+
+    fblocks = fp // f_blk
+    rows = f_blk * max_bins
+    grid = (fblocks, npad // row_chunk)
+    out = pl.pallas_call(
+        functools.partial(_multi_kernel, f_blk=f_blk, group=group,
+                          max_bins=max_bins, precise=precise),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((f_blk, row_chunk), lambda j, i: (j, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_chunk, 3), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_chunk, 1), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 128), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, rows, 128), lambda j, i: (j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((fblocks, rows, 128), jnp.float32),
+        interpret=interpret,
+    )(bins_fm, ghT, row_leaf[:, None].astype(jnp.int32), leafsel)
+    # [fblocks, f_blk*B, 128] -> [F, B, J, 3] -> [J, F, B, 3]
+    out = out[:, :, :3 * num_slots]
+    out = out.reshape(fp, max_bins, num_slots, 3)
+    out = jnp.moveaxis(out, 2, 0)
+    return out[:, :num_features]
+
+
+def hist_multi_xla(bins_fm, ghT, row_leaf, leaf_ids, *, max_bins: int,
+                   num_slots: int) -> jax.Array:
+    """XLA fallback (CPU tests): loop leaves over build_histogram."""
+    from .histogram import build_histogram
+    outs = []
+    for j in range(num_slots):
+        # ghT channels are pre-masked (grad*w, hess*w, w) with w in {0,1},
+        # so the extra *mask inside build_histogram is idempotent
+        m = (row_leaf == leaf_ids[j]).astype(jnp.float32) * ghT[:, 2]
+        outs.append(build_histogram(bins_fm, ghT[:, 0], ghT[:, 1], m,
+                                    max_bins=max_bins, impl="xla"))
+    return jnp.stack(outs)
+
+
+def hist_multi(bins_fm, ghT, row_leaf, leaf_ids, *, max_bins: int,
+               num_slots: int, impl: str = "xla") -> jax.Array:
+    if impl == "pallas":
+        return hist_pallas_multi(bins_fm, ghT, row_leaf, leaf_ids,
+                                 max_bins=max_bins, num_slots=num_slots)
+    return hist_multi_xla(bins_fm, ghT, row_leaf, leaf_ids,
+                          max_bins=max_bins, num_slots=num_slots)
 
 
 @functools.partial(jax.jit,

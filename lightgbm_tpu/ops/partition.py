@@ -18,20 +18,20 @@ from .split import MISSING_NAN
 def apply_split(row_leaf: jax.Array, bins_fm: jax.Array,
                 leaf_id: jax.Array, new_leaf_id: jax.Array,
                 feature: jax.Array, threshold: jax.Array,
-                default_left: jax.Array, num_bins: jax.Array,
-                missing_type: jax.Array, is_categorical: jax.Array,
-                valid: jax.Array) -> jax.Array:
+                default_left: jax.Array, cat_mask: jax.Array,
+                num_bins: jax.Array, missing_type: jax.Array,
+                is_categorical: jax.Array, valid: jax.Array) -> jax.Array:
     """Send rows of `leaf_id` that fail the decision to `new_leaf_id`.
 
     Numerical: bin <= threshold -> left; the NaN bin (last bin when
-    missing_type == NAN) follows `default_left`. Categorical (one-hot):
-    bin == threshold -> left. No-op when `valid` is False.
+    missing_type == NAN) follows `default_left`. Categorical: bins set in
+    `cat_mask` ([B] bool — the device analog of the reference's category
+    bitset, tree.h:375) go left. No-op when `valid` is False.
     """
     fbins = jnp.take(bins_fm, feature, axis=0).astype(jnp.int32)  # [N]
     nan_bin = num_bins[feature] - 1
     is_nan = (missing_type[feature] == MISSING_NAN) & (fbins == nan_bin)
     numerical = jnp.where(is_nan, default_left, fbins <= threshold)
-    go_left = jnp.where(is_categorical[feature], fbins == threshold,
-                        numerical)
+    go_left = jnp.where(is_categorical[feature], cat_mask[fbins], numerical)
     move = valid & (row_leaf == leaf_id) & ~go_left
     return jnp.where(move, new_leaf_id, row_leaf)

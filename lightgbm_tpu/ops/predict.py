@@ -6,6 +6,10 @@ src/io/cuda/cuda_tree.cu). Trees are packed into dense [T, ...] tensors;
 traversal is a `fori_loop` over depth with per-row gathers — all rows
 advance one level per step (leaves self-loop), so the program has static
 shape and vectorizes over the batch.
+
+Categorical splits carry their category-value bitsets in a packed
+[T, W] word tensor (the device mirror of tree.h:375 cat_threshold_ +
+cat_boundaries_), checked with a dynamic word gather per row.
 """
 
 from __future__ import annotations
@@ -30,20 +34,22 @@ class PackedEnsemble(NamedTuple):
     right_child: jax.Array     # [T, I] int32
     leaf_value: jax.Array      # [T, L] f32
     num_internal: jax.Array    # [T] int32
+    cat_start: jax.Array       # [T, I] int32 word offset into cat_words
+    cat_nwords: jax.Array      # [T, I] int32 word count (0 = not cat)
+    cat_words: jax.Array       # [T, W] uint32 bitset words
     max_depth: int             # static
     num_trees_per_class: int   # static (for multiclass reshape)
 
 
 def pack_ensemble(trees: List, num_tree_per_iteration: int = 1
                   ) -> PackedEnsemble:
-    """Pack host Tree objects (tree.py) into device tensors.
-
-    Categorical splits are packed as equality splits on the single category
-    value (the learner emits one-hot categorical splits)."""
+    """Pack host Tree objects (tree.py) into device tensors."""
     t = len(trees)
     max_i = max((tr.num_internal for tr in trees), default=0)
     max_i = max(max_i, 1)
     max_l = max((tr.num_leaves for tr in trees), default=1)
+    max_w = max((len(tr.cat_threshold) for tr in trees), default=0)
+    max_w = max(max_w, 1)
     sf = np.zeros((t, max_i), np.int32)
     th = np.zeros((t, max_i), np.float64)
     dt = np.zeros((t, max_i), np.int32)
@@ -51,6 +57,9 @@ def pack_ensemble(trees: List, num_tree_per_iteration: int = 1
     rc = np.full((t, max_i), -1, np.int32)
     lv = np.zeros((t, max_l), np.float32)
     ni = np.zeros(t, np.int32)
+    cs = np.zeros((t, max_i), np.int32)
+    cn = np.zeros((t, max_i), np.int32)
+    cw = np.zeros((t, max_w), np.uint32)
     depth = 1
     for i, tr in enumerate(trees):
         n = tr.num_internal
@@ -60,29 +69,26 @@ def pack_ensemble(trees: List, num_tree_per_iteration: int = 1
             dt[i, :n] = tr.decision_type
             lc[i, :n] = tr.left_child
             rc[i, :n] = tr.right_child
-            # categorical one-hot: threshold holds the category value and a
-            # flag bit; decision becomes (value == threshold)
-            for nd in range(n):
-                if tr.decision_type[nd] & 1:
-                    cat_idx = int(tr.threshold[nd])
-                    lo = tr.cat_boundaries[cat_idx]
-                    hi = tr.cat_boundaries[cat_idx + 1]
-                    val = -1.0
-                    for w in range(lo, hi):
-                        bits = tr.cat_threshold[w]
-                        for b in range(32):
-                            if (bits >> b) & 1:
-                                val = (w - lo) * 32 + b
-                    th[i, nd] = val
-                else:
-                    th[i, nd] = tr.threshold[nd]
+            th[i, :n] = tr.threshold
+            if tr.num_cat:
+                cw[i, :len(tr.cat_threshold)] = np.asarray(
+                    tr.cat_threshold, np.uint32)
+                for nd in range(n):
+                    if tr.decision_type[nd] & 1:
+                        cat_idx = int(tr.threshold[nd])
+                        cs[i, nd] = tr.cat_boundaries[cat_idx]
+                        cn[i, nd] = (tr.cat_boundaries[cat_idx + 1]
+                                     - tr.cat_boundaries[cat_idx])
         lv[i, :tr.num_leaves] = tr.leaf_value
         depth = max(depth, _tree_depth(tr))
     return PackedEnsemble(
         split_feature=jnp.asarray(sf), threshold=jnp.asarray(th, jnp.float32),
         decision_type=jnp.asarray(dt), left_child=jnp.asarray(lc),
         right_child=jnp.asarray(rc), leaf_value=jnp.asarray(lv),
-        num_internal=jnp.asarray(ni), max_depth=int(depth),
+        num_internal=jnp.asarray(ni),
+        cat_start=jnp.asarray(cs), cat_nwords=jnp.asarray(cn),
+        cat_words=jnp.asarray(cw),
+        max_depth=int(depth),
         num_trees_per_class=num_tree_per_iteration)
 
 
@@ -99,49 +105,76 @@ def _tree_depth(tr) -> int:
     return out + 1
 
 
+def _predict_leaf_one_tree(tree, x, max_depth: int):
+    """Leaf index per row for one packed tree (tuple of arrays)."""
+    sf, th, dt, lc, rc, ni, cs, cn, cw = tree
+    num_rows = x.shape[0]
+
+    def body(_, node):
+        nd = jnp.maximum(node, 0)
+        feat = sf[nd]
+        val = jnp.take_along_axis(x, feat[:, None], axis=1)[:, 0]
+        thr = th[nd]
+        d = dt[nd]
+        default_left = (d & _DEFAULT_LEFT_MASK) > 0
+        missing_type = (d >> 2) & 3
+        is_cat = (d & 1) > 0
+        isnan = jnp.isnan(val)
+        v0 = jnp.where(isnan, 0.0, val)
+        # categorical bitset decision (ref: tree.h:375 CategoricalDecision)
+        v_int = v0.astype(jnp.int32)
+        widx = jnp.clip(cs[nd] + v_int // 32, 0, cw.shape[0] - 1)
+        word = cw[widx]
+        in_range = (~isnan) & (v0 >= 0) & (v_int // 32 < cn[nd])
+        cat_left = in_range & (
+            (word >> (v_int % 32).astype(jnp.uint32)) & 1 > 0)
+        go_left = jnp.where(is_cat, cat_left, v0 <= thr)
+        use_default = (isnan & (missing_type == 2)) | \
+            ((missing_type == 1) & (isnan | (jnp.abs(v0) <= 1e-35)))
+        go_left = jnp.where(use_default & ~is_cat, default_left, go_left)
+        nxt = jnp.where(go_left, lc[nd], rc[nd])
+        # leaves (node < 0) self-loop
+        return jnp.where(node < 0, node, nxt)
+
+    node0 = jnp.where(ni > 0, jnp.zeros(num_rows, jnp.int32),
+                      jnp.full(num_rows, -1, jnp.int32))
+    node = lax.fori_loop(0, max_depth, body, node0)
+    return jnp.where(node < 0, ~node, 0)
+
+
+def _tree_operands(ens: PackedEnsemble):
+    return (ens.split_feature, ens.threshold, ens.decision_type,
+            ens.left_child, ens.right_child, ens.num_internal,
+            ens.cat_start, ens.cat_nwords, ens.cat_words)
+
+
 def predict_raw(ens: PackedEnsemble, x: jax.Array) -> jax.Array:
-    """x: [B, F] raw features (NaN = missing) -> raw scores [B, K]."""
+    """x: [B, F] raw features (NaN = missing) -> raw scores [B]."""
     num_rows = x.shape[0]
 
     def one_tree(carry, tree):
-        sf, th, dt, lc, rc, lv, ni = tree
-
-        def body(_, node):
-            feat = sf[jnp.maximum(node, 0)]
-            val = jnp.take_along_axis(x, feat[:, None], axis=1)[:, 0]
-            thr = th[jnp.maximum(node, 0)]
-            d = dt[jnp.maximum(node, 0)]
-            default_left = (d & _DEFAULT_LEFT_MASK) > 0
-            missing_type = (d >> 2) & 3
-            is_cat = (d & 1) > 0
-            isnan = jnp.isnan(val)
-            v0 = jnp.where(isnan, 0.0, val)
-            go_left = jnp.where(is_cat, v0 == thr, v0 <= thr)
-            use_default = (isnan & (missing_type == 2)) | \
-                ((missing_type == 1) & (isnan | (jnp.abs(v0) <= 1e-35)))
-            go_left = jnp.where(use_default & ~is_cat, default_left, go_left)
-            nxt = jnp.where(go_left, lc[jnp.maximum(node, 0)],
-                            rc[jnp.maximum(node, 0)])
-            # leaves (node < 0) self-loop
-            return jnp.where(node < 0, node, nxt)
-
-        node0 = jnp.where(ni > 0, jnp.zeros(num_rows, jnp.int32),
-                          jnp.full(num_rows, -1, jnp.int32))
-        node = lax.fori_loop(0, ens.max_depth, body, node0)
-        leaf = jnp.where(node < 0, ~node, 0)
+        *nav, lv = tree
+        leaf = _predict_leaf_one_tree(tuple(nav), x, ens.max_depth)
         return carry + lv[leaf], None
 
     total, _ = lax.scan(
         one_tree, jnp.zeros(num_rows, jnp.float32),
-        (ens.split_feature, ens.threshold, ens.decision_type,
-         ens.left_child, ens.right_child, ens.leaf_value, ens.num_internal))
+        _tree_operands(ens) + (ens.leaf_value,))
     return total
+
+
+def predict_leaf_index(ens: PackedEnsemble, x: jax.Array) -> jax.Array:
+    """x: [B, F] -> leaf indices [B, T] (ref: PredictLeafIndex)."""
+    def one_tree(_, tree):
+        return None, _predict_leaf_one_tree(tree, x, ens.max_depth)
+
+    _, leaves = lax.scan(one_tree, None, _tree_operands(ens))
+    return jnp.swapaxes(leaves, 0, 1)
 
 
 def predict_raw_multiclass(ens: PackedEnsemble, x: jax.Array) -> jax.Array:
     """-> [B, K] for K = num_trees_per_class class streams."""
     k = ens.num_trees_per_class
-    num_rows = x.shape[0]
     if k == 1:
         return predict_raw(ens, x)[:, None]
     t = ens.split_feature.shape[0]
@@ -156,6 +189,9 @@ def predict_raw_multiclass(ens: PackedEnsemble, x: jax.Array) -> jax.Array:
             right_child=ens.right_child[idx],
             leaf_value=ens.leaf_value[idx],
             num_internal=ens.num_internal[idx],
+            cat_start=ens.cat_start[idx],
+            cat_nwords=ens.cat_nwords[idx],
+            cat_words=ens.cat_words[idx],
             max_depth=ens.max_depth, num_trees_per_class=1)
         outs.append(predict_raw(sub, x))
     return jnp.stack(outs, axis=1)

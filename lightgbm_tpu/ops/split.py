@@ -36,6 +36,12 @@ class SplitHyperParams(NamedTuple):
     max_delta_step: jax.Array
     path_smooth: jax.Array     # (ref: config.h path_smooth)
     cegb_split_pen: jax.Array  # cegb_tradeoff * cegb_penalty_split
+    cat_l2: jax.Array          # extra L2 for categorical subset splits
+    cat_smooth: jax.Array      # grad/hess ratio smoothing + count filter
+    max_cat_threshold: jax.Array   # max categories sent left
+    max_cat_to_onehot: jax.Array   # one-hot below this many bins
+    min_data_per_group: jax.Array  # min data per categorical group
+    monotone_penalty: jax.Array    # gain penalty on monotone splits
 
     @classmethod
     def from_config(cls, cfg) -> "SplitHyperParams":
@@ -51,6 +57,12 @@ class SplitHyperParams(NamedTuple):
             path_smooth=jnp.asarray(cfg.path_smooth, f),
             cegb_split_pen=jnp.asarray(
                 cfg.cegb_tradeoff * cfg.cegb_penalty_split, f),
+            cat_l2=jnp.asarray(cfg.cat_l2, f),
+            cat_smooth=jnp.asarray(cfg.cat_smooth, f),
+            max_cat_threshold=jnp.asarray(cfg.max_cat_threshold, jnp.int32),
+            max_cat_to_onehot=jnp.asarray(cfg.max_cat_to_onehot, jnp.int32),
+            min_data_per_group=jnp.asarray(cfg.min_data_per_group, f),
+            monotone_penalty=jnp.asarray(cfg.monotone_penalty, f),
         )
 
 
@@ -75,7 +87,12 @@ class FeatureMeta(NamedTuple):
 
 
 class SplitInfo(NamedTuple):
-    """Best split for one leaf — scalar fields (ref: split_info.hpp:22)."""
+    """Best split for one leaf — scalar fields (ref: split_info.hpp:22).
+
+    cat_mask: [B] bool — for categorical splits, the set of bins sent left
+    (the device analog of the reference's cat_threshold bitset,
+    split_info.hpp cat_threshold / tree.h:375). All-False for numerical.
+    """
     gain: jax.Array          # gain above (parent_gain + min_gain_to_split); <=0 => no split
     feature: jax.Array       # int32 feature index
     threshold: jax.Array     # int32 bin threshold (bin <= threshold -> left)
@@ -88,6 +105,7 @@ class SplitInfo(NamedTuple):
     right_count: jax.Array
     left_output: jax.Array
     right_output: jax.Array
+    cat_mask: jax.Array      # [B] bool, bins going left (categorical)
 
 
 def threshold_l1(s: jax.Array, l1: jax.Array) -> jax.Array:
@@ -131,6 +149,34 @@ def leaf_output_smooth(sum_grad, sum_hess, count, parent_output,
                          parent_output, hp)
 
 
+def propagate_monotone_bounds(out_l, out_r, mono_t, is_cat_split,
+                              p_minb, p_maxb):
+    """Children's output bounds after a split (basic method,
+    ref: monotone_constraints.hpp:466 Update): a numerical split on a
+    monotone feature pins the children's shared boundary at the midpoint
+    of their outputs. Returns (l_min, l_max, r_min, r_max)."""
+    upd = ~is_cat_split & (mono_t != 0)
+    mid = (out_l + out_r) * 0.5
+    l_max = jnp.where(upd & (mono_t > 0), jnp.minimum(p_maxb, mid), p_maxb)
+    l_min = jnp.where(upd & (mono_t < 0), jnp.maximum(p_minb, mid), p_minb)
+    r_min = jnp.where(upd & (mono_t > 0), jnp.maximum(p_minb, mid), p_minb)
+    r_max = jnp.where(upd & (mono_t < 0), jnp.minimum(p_maxb, mid), p_maxb)
+    return l_min, l_max, r_min, r_max
+
+
+def _monotone_penalty_factor(depth, hp: SplitHyperParams):
+    """Multiplicative gain penalty for splits on monotone-constrained
+    features (ref: monotone_constraints.hpp:358
+    ComputeMonotoneSplitGainPenalty)."""
+    pen = hp.monotone_penalty
+    dep = jnp.maximum(depth, 0).astype(jnp.float32)
+    factor = jnp.where(
+        pen >= dep + 1.0, K_EPSILON,
+        jnp.where(pen <= 1.0, 1.0 - pen / (2.0 ** dep) + K_EPSILON,
+                  1.0 - 2.0 ** (pen - 1.0 - dep) + K_EPSILON))
+    return jnp.where(pen > 0, factor, 1.0)
+
+
 def _gain_tensors(hist: jax.Array,
                   parent_sum_grad: jax.Array,
                   parent_sum_hess: jax.Array,
@@ -138,9 +184,22 @@ def _gain_tensors(hist: jax.Array,
                   meta: FeatureMeta,
                   hp: SplitHyperParams,
                   feature_mask: jax.Array,
-                  parent_output):
-    """Candidate gains for every (feature, threshold, missing-direction)
-    variant. Returns (gains [F, B, 3], left_a, right_b, left_c, parent)."""
+                  parent_output,
+                  min_bound,
+                  max_bound,
+                  depth,
+                  has_categorical: bool):
+    """NET candidate gains for every (feature, threshold, variant).
+
+    Variants: A numerical/missing-right, B numerical/missing-left,
+    C categorical one-hot, and (when has_categorical) D/E categorical
+    sorted-subset scans in ascending/descending grad-ratio order
+    (ref: feature_histogram.cpp:243-344 categorical branch).
+
+    Gains are net of (parent_gain + min_gain_to_split) with the monotone
+    split penalty applied, so a positive entry is a strictly improving
+    split. Returns (gains [F, B, V], aux dict).
+    """
     num_features, num_bin_slots, _ = hist.shape
     prefix = jnp.cumsum(hist, axis=1)  # [F, B, 3]
     t_idx = jnp.arange(num_bin_slots, dtype=jnp.int32)[None, :]  # [1, B]
@@ -156,21 +215,40 @@ def _gain_tensors(hist: jax.Array,
 
     parent = jnp.stack([parent_sum_grad, parent_sum_hess, parent_count])
 
+    # net-gain shift (ref: FindBestThresholdFromHistogram min_gain_shift;
+    # with smoothing the parent's gain is evaluated at its actual output)
+    parent_gain = jnp.where(
+        hp.path_smooth > 0,
+        leaf_gain_given_output(parent_sum_grad, parent_sum_hess,
+                               parent_output, hp),
+        leaf_gain(parent_sum_grad, parent_sum_hess, hp))
+    shift = parent_gain + hp.min_gain_to_split
+
+    # monotone split penalty (multiplies the net gain of candidates on
+    # monotone features; ref: serial_tree_learner.cpp:1001-1005)
+    mono_factor = _monotone_penalty_factor(depth, hp)
+    mono_feat = (meta.monotone != 0)[:, None]
+
     # CEGB delta per feature (ref: cost_effective_gradient_boosting.hpp
     # DeltaGain: tradeoff*penalty_split*n_leaf + coupled-first-use +
     # lazy per-row costs; coupled/lazy are pre-scaled by tradeoff on host)
     cegb_delta = (meta.cegb_feat
                   + (hp.cegb_split_pen + meta.cegb_lazy) * parent_count)
 
-    def eval_variant(left, right, valid_extra):
+    def eval_variant(left, right, valid_extra, hp_eff):
         gl, hl, cl = left[..., GRAD], left[..., HESS], left[..., COUNT]
         gr, hr, cr = right[..., GRAD], right[..., HESS], right[..., COUNT]
-        out_l = smooth_output(leaf_output(gl, hl, hp), cl, parent_output, hp)
-        out_r = smooth_output(leaf_output(gr, hr, hp), cr, parent_output, hp)
-        gain = (leaf_gain_given_output(gl, hl, out_l, hp)
-                + leaf_gain_given_output(gr, hr, out_r, hp))
-        # monotone constraints, basic method (ref: monotone_constraints.hpp:466):
-        # increasing (+1) requires left_output <= right_output.
+        out_l = smooth_output(leaf_output(gl, hl, hp_eff), cl, parent_output,
+                              hp_eff)
+        out_r = smooth_output(leaf_output(gr, hr, hp_eff), cr, parent_output,
+                              hp_eff)
+        # per-leaf output bounds from ancestors' monotone splits
+        # (ref: monotone_constraints.hpp:466 BasicLeafConstraints)
+        out_l = jnp.clip(out_l, min_bound, max_bound)
+        out_r = jnp.clip(out_r, min_bound, max_bound)
+        gain = (leaf_gain_given_output(gl, hl, out_l, hp_eff)
+                + leaf_gain_given_output(gr, hr, out_r, hp_eff))
+        # monotone split check: increasing (+1) needs out_l <= out_r
         mono = meta.monotone[:, None]
         mono_ok = jnp.where(
             mono == 0, True,
@@ -184,42 +262,120 @@ def _gain_tensors(hist: jax.Array,
             & (hr >= hp.min_sum_hessian_in_leaf)
             & feature_mask[:, None]
         )
-        gain = gain * meta.penalty[:, None] - cegb_delta[:, None]
-        return jnp.where(valid, gain, K_MIN_SCORE)
+        net = (gain * meta.penalty[:, None] - cegb_delta[:, None] - shift)
+        net = jnp.where(mono_feat, net * mono_factor, net)
+        return jnp.where(valid, net, K_MIN_SCORE)
 
     is_cat = meta.is_categorical[:, None]
     base_valid_a = (t_idx < nb - 1) & ~is_cat
-    gains_a = eval_variant(left_a, parent[None, None, :] - left_a, base_valid_a)
+    gains_a = eval_variant(left_a, parent[None, None, :] - left_a,
+                           base_valid_a, hp)
 
     has_nan = meta.missing_type[:, None] == MISSING_NAN
     base_valid_b = has_nan & (t_idx < nb - 2) & ~is_cat
-    gains_b = eval_variant(parent[None, None, :] - right_b, right_b, base_valid_b)
+    gains_b = eval_variant(parent[None, None, :] - right_b, right_b,
+                           base_valid_b, hp)
 
     # --- variant C: categorical one-hot split, bin == t goes LEFT
-    # (ref: feature_histogram.hpp categorical one-hot branch when
+    # (ref: feature_histogram.cpp:188-242 one-hot branch when
     # num_bins <= max_cat_to_onehot; bin 0 = "other/unseen" never splits
     # left so binned and raw-value prediction stay consistent)
     left_c = hist
-    base_valid_c = is_cat & (t_idx >= 1) & (t_idx < nb)
+    onehot_ok = nb <= hp.max_cat_to_onehot
+    base_valid_c = is_cat & onehot_ok & (t_idx >= 1) & (t_idx < nb)
     gains_c = eval_variant(left_c, parent[None, None, :] - left_c,
-                           base_valid_c)
+                           base_valid_c, hp)
 
-    gains = jnp.stack([gains_a, gains_b, gains_c], axis=-1)  # [F, B, 3]
-    return gains, left_a, right_b, left_c, parent
+    aux = dict(left_a=left_a, right_b=right_b, left_c=left_c, parent=parent,
+               parent_gain=parent_gain)
+
+    if not has_categorical:
+        gains = jnp.stack([gains_a, gains_b, gains_c], axis=-1)  # [F, B, 3]
+        return gains, aux
+
+    # --- variants D/E: categorical sorted-subset scan
+    # (ref: feature_histogram.cpp:243-344): bins with enough estimated
+    # count enter, sorted ascending by g/(h + cat_smooth); prefixes of the
+    # sorted order (D) and of the reversed order (E) go left, with
+    # l2 += cat_l2 and a min_data_per_group thinning of candidates.
+    hp_cat = hp._replace(lambda_l2=hp.lambda_l2 + hp.cat_l2)
+    g_b, h_b, c_b = hist[..., GRAD], hist[..., HESS], hist[..., COUNT]
+    eligible = (t_idx >= 1) & (t_idx < nb) & (c_b >= hp.cat_smooth) & is_cat
+    ratio = g_b / (h_b + hp.cat_smooth)
+    sort_key = jnp.where(eligible, ratio, jnp.inf)
+    order = jnp.argsort(sort_key, axis=1)                    # [F, B]
+    rank = jnp.argsort(order, axis=1).astype(jnp.int32)       # [F, B]
+    used = jnp.sum(eligible, axis=1).astype(jnp.int32)        # [F]
+    sorted_hist = jnp.take_along_axis(hist, order[:, :, None], axis=1)
+    pos_ok = t_idx < used[:, None]
+    sorted_hist = jnp.where(pos_ok[:, :, None], sorted_hist, 0.0)
+    sortP = jnp.cumsum(sorted_hist, axis=1)                   # [F, B, 3]
+    totalP = jnp.take_along_axis(
+        sortP, jnp.maximum(used - 1, 0)[:, None, None], axis=1)  # [F,1,3]
+    totalP = jnp.where((used > 0)[:, None, None], totalP, 0.0)
+
+    # descending-direction prefix: last i+1 eligible bins
+    idx_rev = used[:, None] - 2 - t_idx                       # [F, B]
+    take_rev = jnp.take_along_axis(
+        sortP, jnp.clip(idx_rev, 0, num_bin_slots - 1)[:, :, None], axis=1)
+    left_e = totalP - jnp.where((idx_rev >= 0)[:, :, None], take_rev, 0.0)
+
+    # candidate validity: position in range, bounded subset size
+    # (max_num_cat = min(max_cat_threshold, (used+1)/2),
+    #  feature_histogram.cpp:267-269)
+    max_num_cat = jnp.minimum(hp.max_cat_threshold, (used[:, None] + 1) // 2)
+    cat_pos_ok = pos_ok & (t_idx < max_num_cat) & is_cat & ~onehot_ok
+    # min_data_per_group thinning: emit a candidate only when the data
+    # accumulated since the previous candidate reaches the group minimum.
+    # The reference resets a running counter at each emission
+    # (feature_histogram.cpp:280-317); the crossing-of-multiples form
+    # below is its vectorized equivalent up to overshoot at boundaries.
+    G = jnp.maximum(hp.min_data_per_group, 1.0)
+
+    def group_ok(P):
+        cum_c = P[..., COUNT]
+        prev_c = jnp.concatenate(
+            [jnp.zeros_like(cum_c[:, :1]), cum_c[:, :-1]], axis=1)
+        return jnp.floor(cum_c / G) > jnp.floor(prev_c / G)
+
+    # right side must also keep min_data_per_group
+    # (feature_histogram.cpp:302-305)
+    right_big_d = (parent[COUNT] - sortP[..., COUNT]) >= G
+    right_big_e = (parent[COUNT] - left_e[..., COUNT]) >= G
+    gains_d = eval_variant(sortP, parent[None, None, :] - sortP,
+                           cat_pos_ok & group_ok(sortP) & right_big_d, hp_cat)
+    gains_e = eval_variant(left_e, parent[None, None, :] - left_e,
+                           cat_pos_ok & group_ok(left_e) & right_big_e,
+                           hp_cat)
+
+    gains = jnp.stack([gains_a, gains_b, gains_c, gains_d, gains_e],
+                      axis=-1)  # [F, B, 5]
+    aux.update(sortP=sortP, left_e=left_e, rank=rank, used=used,
+               eligible=eligible)
+    return gains, aux
 
 
 def per_feature_best_gain(hist, parent_sum_grad, parent_sum_hess,
                           parent_count, meta: FeatureMeta,
                           hp: SplitHyperParams, feature_mask,
-                          parent_output=None) -> jax.Array:
-    """Best candidate gain per feature ([F]) — the voting statistic each
-    worker computes from its local histograms (ref:
+                          parent_output=None, min_bound=None, max_bound=None,
+                          depth=None, has_categorical: bool = True
+                          ) -> jax.Array:
+    """Best candidate net gain per feature ([F]) — the voting statistic
+    each worker computes from its local histograms (ref:
     voting_parallel_tree_learner.cpp:353 local FindBestThreshold + MaxK)."""
     if parent_output is None:
         parent_output = jnp.float32(0.0)
-    gains, *_ = _gain_tensors(hist, parent_sum_grad, parent_sum_hess,
-                              parent_count, meta, hp, feature_mask,
-                              parent_output)
+    if min_bound is None:
+        min_bound = jnp.float32(-jnp.inf)
+    if max_bound is None:
+        max_bound = jnp.float32(jnp.inf)
+    if depth is None:
+        depth = jnp.int32(1)
+    gains, _ = _gain_tensors(hist, parent_sum_grad, parent_sum_hess,
+                             parent_count, meta, hp, feature_mask,
+                             parent_output, min_bound, max_bound, depth,
+                             has_categorical)
     return jnp.max(gains, axis=(1, 2))
 
 
@@ -230,51 +386,83 @@ def find_best_split(hist: jax.Array,
                     meta: FeatureMeta,
                     hp: SplitHyperParams,
                     feature_mask: jax.Array,
-                    parent_output=None) -> SplitInfo:
-    """Find the best numerical split across all features for one leaf.
+                    parent_output=None,
+                    min_bound=None,
+                    max_bound=None,
+                    depth=None,
+                    has_categorical: bool = True) -> SplitInfo:
+    """Find the best split across all features for one leaf.
 
     hist: [F, B, 3]; parent_*: scalars; feature_mask: [F] bool (feature
     fraction / interaction constraints); parent_output: scalar output of
-    the leaf being split (path smoothing). Returns scalar SplitInfo.
+    the leaf being split (path smoothing); min_bound/max_bound: the
+    leaf's output bounds from ancestor monotone splits; depth: the
+    leaf's depth (monotone penalty). Returns scalar SplitInfo.
     """
     if parent_output is None:
         parent_output = jnp.float32(0.0)
+    if min_bound is None:
+        min_bound = jnp.float32(-jnp.inf)
+    if max_bound is None:
+        max_bound = jnp.float32(jnp.inf)
+    if depth is None:
+        depth = jnp.int32(1)
     num_bin_slots = hist.shape[1]
-    gains, left_a, right_b, left_c, parent = _gain_tensors(
+    gains, aux = _gain_tensors(
         hist, parent_sum_grad, parent_sum_hess, parent_count, meta, hp,
-        feature_mask, parent_output)
+        feature_mask, parent_output, min_bound, max_bound, depth,
+        has_categorical)
+    parent = aux["parent"]
+    num_variants = gains.shape[-1]
     flat = gains.reshape(-1)
     best = jnp.argmax(flat)
-    best_gain_raw = flat[best]
+    gain = flat[best]  # already net of parent gain + min_gain_to_split
 
-    num_variants = 3
     feature = (best // (num_bin_slots * num_variants)).astype(jnp.int32)
     threshold = ((best // num_variants) % num_bin_slots).astype(jnp.int32)
     variant = (best % num_variants).astype(jnp.int32)
     variant_b = variant == 1
     variant_c = variant == 2
 
-    la = left_a[feature, threshold]
-    rb = right_b[feature, threshold]
-    lc_ = left_c[feature, threshold]
+    la = aux["left_a"][feature, threshold]
+    rb = aux["right_b"][feature, threshold]
+    lc_ = aux["left_c"][feature, threshold]
     left = jnp.where(variant_b, parent - rb, jnp.where(variant_c, lc_, la))
+    bidx = jnp.arange(num_bin_slots, dtype=jnp.int32)
+    cat_mask = variant_c & (bidx == threshold)
+
+    if num_variants == 5:
+        variant_d = variant == 3
+        variant_e = variant == 4
+        ld = aux["sortP"][feature, threshold]
+        le = aux["left_e"][feature, threshold]
+        left = jnp.where(variant_d, ld, jnp.where(variant_e, le, left))
+        rank_f = aux["rank"][feature]
+        used_f = aux["used"][feature]
+        elig_f = aux["eligible"][feature]
+        mask_d = (rank_f <= threshold) & elig_f
+        mask_e = (rank_f >= used_f - 1 - threshold) & elig_f
+        cat_mask = jnp.where(variant_d, mask_d,
+                             jnp.where(variant_e, mask_e, cat_mask))
     right = parent - left
 
-    # with smoothing, the parent's gain is evaluated at its actual
-    # (smoothed) output (ref: FindBestThresholdFromHistogram min_gain_shift)
-    parent_gain = jnp.where(
-        hp.path_smooth > 0,
-        leaf_gain_given_output(parent_sum_grad, parent_sum_hess,
-                               parent_output, hp),
-        leaf_gain(parent_sum_grad, parent_sum_hess, hp))
-    gain = best_gain_raw - parent_gain - hp.min_gain_to_split
-    gain = jnp.where(best_gain_raw <= K_MIN_SCORE * 0.5, K_MIN_SCORE, gain)
+    is_cat_split = variant >= 2
+    l2_eff = hp.lambda_l2 + jnp.where(variant >= 3, hp.cat_l2, 0.0)
+    hp_out = hp._replace(lambda_l2=l2_eff)
 
     mt = meta.missing_type[feature]
     default_left = jnp.where(
-        mt == MISSING_NAN, variant_b,
-        jnp.where(mt == MISSING_ZERO,
-                  meta.default_bin[feature] <= threshold, False))
+        is_cat_split, False,
+        jnp.where(mt == MISSING_NAN, variant_b,
+                  jnp.where(mt == MISSING_ZERO,
+                            meta.default_bin[feature] <= threshold, False)))
+
+    out_l = jnp.clip(
+        leaf_output_smooth(left[GRAD], left[HESS], left[COUNT],
+                           parent_output, hp_out), min_bound, max_bound)
+    out_r = jnp.clip(
+        leaf_output_smooth(right[GRAD], right[HESS], right[COUNT],
+                           parent_output, hp_out), min_bound, max_bound)
 
     return SplitInfo(
         gain=gain,
@@ -283,8 +471,7 @@ def find_best_split(hist: jax.Array,
         default_left=default_left,
         left_sum_grad=left[GRAD], left_sum_hess=left[HESS], left_count=left[COUNT],
         right_sum_grad=right[GRAD], right_sum_hess=right[HESS], right_count=right[COUNT],
-        left_output=leaf_output_smooth(left[GRAD], left[HESS], left[COUNT],
-                                       parent_output, hp),
-        right_output=leaf_output_smooth(right[GRAD], right[HESS],
-                                        right[COUNT], parent_output, hp),
+        left_output=out_l,
+        right_output=out_r,
+        cat_mask=cat_mask,
     )

@@ -86,7 +86,8 @@ class VotingParallelGBDT(_DataParallelMixin, GBDT):
             top_k = max(1, min(int(config.top_k),
                                self.train_set.num_features))
             grow = make_sharded_voting_grow(
-                self.mesh, top_k=top_k, hist_impl="xla", **self._static)
+                self.mesh, top_k=top_k, hist_impl="xla",
+                has_categorical=self._has_categorical, **self._static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
                               forced=None):
@@ -122,8 +123,9 @@ class FeatureParallelGBDT(GBDT):
                 lambda a: mesh_lib.replicate(self.mesh, a),
                 self.feature_meta)
             from .feature_parallel import make_sharded_feature_grow
-            grow = make_sharded_feature_grow(self.mesh, hist_impl="xla",
-                                             **self._static)
+            grow = make_sharded_feature_grow(
+                self.mesh, hist_impl="xla",
+                has_categorical=self._has_categorical, **self._static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
                               forced=None):

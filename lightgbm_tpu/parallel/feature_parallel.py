@@ -28,7 +28,7 @@ from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams,
                          SplitInfo, find_best_split, leaf_output,
-                         leaf_output_smooth)
+                         propagate_monotone_bounds)
 from . import mesh as mesh_lib
 
 
@@ -49,7 +49,8 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
                                num_shards: int,
                                axis_name: str = mesh_lib.DATA_AXIS,
                                hist_dtype=jnp.float32,
-                               hist_impl: str = "xla"):
+                               hist_impl: str = "xla",
+                               has_categorical: bool = True):
     """Runs INSIDE shard_map with fully-replicated inputs; each shard
     works on its feature slice. Outputs are replicated."""
     num_features = bins_fm.shape[0]
@@ -77,8 +78,11 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
     root_h = jnp.sum(hess * sample_mask, dtype=f32)
     root_c = jnp.sum(sample_mask, dtype=f32)
     root_out = leaf_output(root_g, root_h, hp)
+    neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
     root_split = sync(find_best_split(root_hist, root_g, root_h, root_c,
-                                      meta_loc, hp, fmask_loc, root_out))
+                                      meta_loc, hp, fmask_loc, root_out,
+                                      neg_inf, pos_inf, jnp.int32(0),
+                                      has_categorical))
 
     zero_l = jnp.zeros((L,), f32)
     leaves = _LeafSplits(
@@ -89,9 +93,13 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
         threshold=jnp.zeros((L,), jnp.int32),
         default_left=jnp.zeros((L,), jnp.bool_),
         left_sum_grad=zero_l, left_sum_hess=zero_l, left_count=zero_l,
+        left_output=zero_l, right_output=zero_l,
+        cat_mask=jnp.zeros((L, max_bins), jnp.bool_),
+        min_bound=jnp.full((L,), -jnp.inf, f32),
+        max_bound=jnp.full((L,), jnp.inf, f32),
     )
     leaves = _store_split(leaves, 0, root_split, jnp.int32(1), root_out,
-                          root_g, root_h, root_c, True)
+                          root_g, root_h, root_c, neg_inf, pos_inf, True)
 
     pool = jnp.zeros((L, fp, max_bins, hist_ops.NUM_HIST_CHANNELS), f32)
     pool = pool.at[0].set(root_hist)
@@ -106,11 +114,12 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
         feat = leaves.feature[best_leaf]  # GLOBAL feature index
         thr = leaves.threshold[best_leaf]
         dleft = leaves.default_left[best_leaf]
+        cmask = leaves.cat_mask[best_leaf]
 
         # full data on every shard: apply the split locally, no row sync
         # (ref: feature-parallel "no row sync" property)
         row_leaf = part_ops.apply_split(
-            row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
+            row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft, cmask,
             meta.num_bins, meta.missing_type, meta.is_categorical, valid)
 
         lg = leaves.left_sum_grad[best_leaf]
@@ -134,14 +143,23 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
             jnp.where(valid, right_hist, pool[new_leaf]))
 
         parent_out = leaves.output[best_leaf]
-        out_l = leaf_output_smooth(lg, lh, lc, parent_out, hp)
-        out_r = leaf_output_smooth(rg, rh, rc, parent_out, hp)
+        p_minb = leaves.min_bound[best_leaf]
+        p_maxb = leaves.max_bound[best_leaf]
+        out_l = leaves.left_output[best_leaf]
+        out_r = leaves.right_output[best_leaf]
+
+        l_min, l_max, r_min, r_max = propagate_monotone_bounds(
+            out_l, out_r, meta.monotone[feat].astype(jnp.int32),
+            meta.is_categorical[feat], p_minb, p_maxb)
 
         child_depth = leaves.depth[best_leaf] + 1
+        pen_depth = child_depth - 1
         split_l = sync(find_best_split(left_hist, lg, lh, lc, meta_loc,
-                                       hp, fmask_loc, out_l))
+                                       hp, fmask_loc, out_l, l_min, l_max,
+                                       pen_depth, has_categorical))
         split_r = sync(find_best_split(right_hist, rg, rh, rc, meta_loc,
-                                       hp, fmask_loc, out_r))
+                                       hp, fmask_loc, out_r, r_min, r_max,
+                                       pen_depth, has_categorical))
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         split_l = split_l._replace(
             gain=jnp.where(depth_ok, split_l.gain, K_MIN_SCORE))
@@ -150,9 +168,9 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
 
         chosen_gain = leaves.gain[best_leaf]
         leaves = _store_split(leaves, best_leaf, split_l, child_depth,
-                              out_l, lg, lh, lc, valid)
+                              out_l, lg, lh, lc, l_min, l_max, valid)
         leaves = _store_split(leaves, new_leaf, split_r, child_depth,
-                              out_r, rg, rh, rc, valid)
+                              out_r, rg, rh, rc, r_min, r_max, valid)
 
         record = dict(
             split_leaf=jnp.where(valid, best_leaf, -1),
@@ -160,6 +178,7 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
             split_bin_threshold=thr,
             split_default_left=dleft,
             split_gain=jnp.where(valid, chosen_gain, 0.0),
+            split_cat_mask=cmask,
             internal_value=parent_out,
             internal_weight=ph,
             internal_count=pc,
@@ -178,6 +197,7 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
         split_bin_threshold=records["split_bin_threshold"],
         split_default_left=records["split_default_left"],
         split_gain=records["split_gain"],
+        split_cat_mask=records["split_cat_mask"],
         internal_value=records["internal_value"],
         internal_weight=records["internal_weight"],
         internal_count=records["internal_count"],
@@ -190,12 +210,14 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
 
 
 def make_sharded_feature_grow(mesh, *, num_leaves: int, max_bins: int,
-                              hist_impl: str = "xla"):
+                              hist_impl: str = "xla",
+                              has_categorical: bool = True):
     """jit(shard_map(grow_tree_feature_parallel)): everything replicated
     in and out; sharding is purely over the computation."""
     grow = functools.partial(grow_tree_feature_parallel,
                              num_leaves=num_leaves, max_bins=max_bins,
-                             num_shards=mesh.size, hist_impl=hist_impl)
+                             num_shards=mesh.size, hist_impl=hist_impl,
+                             has_categorical=has_categorical)
     rep = P()
     meta_spec = FeatureMeta(*([rep] * len(FeatureMeta._fields)))
     hp_spec = SplitHyperParams(*([rep] * len(SplitHyperParams._fields)))

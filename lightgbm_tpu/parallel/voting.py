@@ -30,8 +30,8 @@ from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
 from ..ops.histogram import COUNT, GRAD, HESS
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams,
-                         find_best_split, leaf_output, leaf_output_smooth,
-                         per_feature_best_gain)
+                         find_best_split, leaf_output, per_feature_best_gain,
+                         propagate_monotone_bounds)
 from . import mesh as mesh_lib
 
 
@@ -42,9 +42,10 @@ def _local_leaf_sums(local_hist: jax.Array):
     return s[GRAD], s[HESS], s[COUNT]
 
 
-def _vote_and_reduce(local_hist, pg, ph, pc, parent_out, meta, hp,
-                     feature_mask, *, num_candidates: int, top_k: int,
-                     axis_name: str):
+def _vote_and_reduce(local_hist, pg, ph, pc, parent_out, min_b, max_b,
+                     depth, meta, hp, feature_mask, *,
+                     num_candidates: int, top_k: int, axis_name: str,
+                     has_categorical: bool = True):
     """One voting round for one leaf: local top-k proposal -> global vote
     -> candidate-only histogram psum -> global best split.
 
@@ -54,7 +55,9 @@ def _vote_and_reduce(local_hist, pg, ph, pc, parent_out, meta, hp,
     """
     lg, lh, lc = _local_leaf_sums(local_hist)
     local_gain = per_feature_best_gain(local_hist, lg, lh, lc, meta, hp,
-                                       feature_mask, parent_out)  # [F]
+                                       feature_mask, parent_out,
+                                       min_b, max_b, depth,
+                                       has_categorical)  # [F]
     num_features = local_gain.shape[0]
 
     # --- vote: each shard proposes its top-k features
@@ -73,7 +76,8 @@ def _vote_and_reduce(local_hist, pg, ph, pc, parent_out, meta, hp,
     cand_hist = lax.psum(local_hist[cand], axis_name)          # [C, B, 3]
     cand_meta = jax.tree_util.tree_map(lambda a: a[cand], meta)
     info = find_best_split(cand_hist, pg, ph, pc, cand_meta, hp,
-                           feature_mask[cand], parent_out)
+                           feature_mask[cand], parent_out, min_b, max_b,
+                           depth, has_categorical)
     return info._replace(feature=cand[info.feature])
 
 
@@ -81,7 +85,8 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
                      meta: FeatureMeta, hp: SplitHyperParams, max_depth,
                      *, num_leaves: int, max_bins: int, top_k: int,
                      axis_name: str = mesh_lib.DATA_AXIS,
-                     hist_dtype=jnp.float32, hist_impl: str = "xla"):
+                     hist_dtype=jnp.float32, hist_impl: str = "xla",
+                     has_categorical: bool = True):
     """Grow one tree with voting-parallel split search. Runs INSIDE
     shard_map: all row-indexed inputs are this shard's slice; returned
     TreeArrays are replicated, row_leaf is the local slice."""
@@ -96,7 +101,8 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
                               dtype=f32, row_chunk=0, impl=hist_impl)
     vote = functools.partial(_vote_and_reduce, meta=meta, hp=hp,
                              feature_mask=feature_mask, num_candidates=C,
-                             top_k=k_eff, axis_name=axis_name)
+                             top_k=k_eff, axis_name=axis_name,
+                             has_categorical=has_categorical)
 
     # --- root: local histogram; global sums by psum (ref: data_parallel
     # root Allreduce, data_parallel_tree_learner.cpp:170)
@@ -105,7 +111,9 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
     root_h = lax.psum(jnp.sum(hess * sample_mask, dtype=f32), axis_name)
     root_c = lax.psum(jnp.sum(sample_mask, dtype=f32), axis_name)
     root_out = leaf_output(root_g, root_h, hp)
-    root_split = vote(root_hist, root_g, root_h, root_c, root_out)
+    neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
+    root_split = vote(root_hist, root_g, root_h, root_c, root_out,
+                      neg_inf, pos_inf, jnp.int32(0))
 
     zero_l = jnp.zeros((L,), f32)
     leaves = _LeafSplits(
@@ -116,9 +124,13 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
         threshold=jnp.zeros((L,), jnp.int32),
         default_left=jnp.zeros((L,), jnp.bool_),
         left_sum_grad=zero_l, left_sum_hess=zero_l, left_count=zero_l,
+        left_output=zero_l, right_output=zero_l,
+        cat_mask=jnp.zeros((L, max_bins), jnp.bool_),
+        min_bound=jnp.full((L,), -jnp.inf, f32),
+        max_bound=jnp.full((L,), jnp.inf, f32),
     )
     leaves = _store_split(leaves, 0, root_split, jnp.int32(1), root_out,
-                          root_g, root_h, root_c, True)
+                          root_g, root_h, root_c, neg_inf, pos_inf, True)
 
     pool = jnp.zeros((L, num_features, max_bins,
                       hist_ops.NUM_HIST_CHANNELS), f32)
@@ -134,9 +146,10 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
         feat = leaves.feature[best_leaf]
         thr = leaves.threshold[best_leaf]
         dleft = leaves.default_left[best_leaf]
+        cmask = leaves.cat_mask[best_leaf]
 
         row_leaf = part_ops.apply_split(
-            row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
+            row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft, cmask,
             meta.num_bins, meta.missing_type, meta.is_categorical, valid)
 
         # global child sums come from the stored (globally-reduced) split
@@ -162,12 +175,20 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
             jnp.where(valid, right_hist, pool[new_leaf]))
 
         parent_out = leaves.output[best_leaf]
-        out_l = leaf_output_smooth(lg, lh, lc, parent_out, hp)
-        out_r = leaf_output_smooth(rg, rh, rc, parent_out, hp)
+        p_minb = leaves.min_bound[best_leaf]
+        p_maxb = leaves.max_bound[best_leaf]
+        out_l = leaves.left_output[best_leaf]
+        out_r = leaves.right_output[best_leaf]
+
+        l_min, l_max, r_min, r_max = propagate_monotone_bounds(
+            out_l, out_r, meta.monotone[feat].astype(jnp.int32),
+            meta.is_categorical[feat], p_minb, p_maxb)
 
         child_depth = leaves.depth[best_leaf] + 1
-        split_l = vote(left_hist, lg, lh, lc, out_l)
-        split_r = vote(right_hist, rg, rh, rc, out_r)
+        pen_depth = child_depth - 1
+        split_l = vote(left_hist, lg, lh, lc, out_l, l_min, l_max, pen_depth)
+        split_r = vote(right_hist, rg, rh, rc, out_r, r_min, r_max,
+                       pen_depth)
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         split_l = split_l._replace(
             gain=jnp.where(depth_ok, split_l.gain, K_MIN_SCORE))
@@ -176,9 +197,9 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
 
         chosen_gain = leaves.gain[best_leaf]
         leaves = _store_split(leaves, best_leaf, split_l, child_depth,
-                              out_l, lg, lh, lc, valid)
+                              out_l, lg, lh, lc, l_min, l_max, valid)
         leaves = _store_split(leaves, new_leaf, split_r, child_depth,
-                              out_r, rg, rh, rc, valid)
+                              out_r, rg, rh, rc, r_min, r_max, valid)
 
         record = dict(
             split_leaf=jnp.where(valid, best_leaf, -1),
@@ -186,6 +207,7 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
             split_bin_threshold=thr,
             split_default_left=dleft,
             split_gain=jnp.where(valid, chosen_gain, 0.0),
+            split_cat_mask=cmask,
             internal_value=parent_out,
             internal_weight=ph,
             internal_count=pc,
@@ -204,6 +226,7 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
         split_bin_threshold=records["split_bin_threshold"],
         split_default_left=records["split_default_left"],
         split_gain=records["split_gain"],
+        split_cat_mask=records["split_cat_mask"],
         internal_value=records["internal_value"],
         internal_weight=records["internal_weight"],
         internal_count=records["internal_count"],
@@ -216,16 +239,18 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
 
 
 def make_sharded_voting_grow(mesh, *, num_leaves: int, max_bins: int,
-                             top_k: int, hist_impl: str = "xla"):
+                             top_k: int, hist_impl: str = "xla",
+                             has_categorical: bool = True):
     """jit(shard_map(grow_tree_voting)): rows sharded over "data",
     everything else replicated; tree replicated out, row_leaf sharded."""
     grow = functools.partial(grow_tree_voting, num_leaves=num_leaves,
                              max_bins=max_bins, top_k=top_k,
-                             hist_impl=hist_impl)
+                             hist_impl=hist_impl,
+                             has_categorical=has_categorical)
     data = P(None, mesh_lib.DATA_AXIS)   # bins [F, N]
     rows = P(mesh_lib.DATA_AXIS)         # [N]
     rep = P()
-    meta_spec = FeatureMeta(rep, rep, rep, rep, rep, rep, rep, rep)
+    meta_spec = FeatureMeta(*([rep] * len(FeatureMeta._fields)))
     hp_spec = SplitHyperParams(*([rep] * len(SplitHyperParams._fields)))
     tree_spec = TreeArrays(*([rep] * len(TreeArrays._fields)))
     sharded = jax.shard_map(

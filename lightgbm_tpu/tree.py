@@ -112,16 +112,27 @@ class Tree:
         return tree
 
     def _add_categorical(self, node, mapper, tbin, rec, s):
-        """Categorical split: bins in the recorded set go left. The learner
-        encodes one-hot categorical splits as bin == threshold -> left
-        (ref: tree.h:375 CategoricalDecision bitset)."""
-        cat_value = mapper.bin_to_value(tbin)
-        # bitset over category values (ref: Common::ConstructBitset)
-        max_val = int(max(cat_value, 0))
+        """Categorical split: the bins in the recorded cat_mask go left,
+        converted to a bitset over raw category values
+        (ref: tree.h:375 CategoricalDecision bitset,
+        Common::ConstructBitset). Legacy records without a mask fall back
+        to one-hot on the threshold bin."""
+        mask = rec.get("split_cat_mask")
+        if mask is not None:
+            bins_left = [int(b) for b in np.flatnonzero(mask[s])
+                         if 1 <= b <= len(mapper.cat_bin_to_value)]
+        else:
+            bins_left = []
+        if not bins_left:
+            bins_left = [int(tbin)]
+        values = [int(mapper.bin_to_value(b)) for b in bins_left]
+        values = [v for v in values if v >= 0] or [0]
+        max_val = max(values)
         nwords = max_val // 32 + 1
         bits = [0] * nwords
-        bits[max_val // 32] |= 1 << (max_val % 32)
-        self.threshold[node] = self.num_cat  # threshold_bin keeps the bin
+        for v in values:
+            bits[v // 32] |= 1 << (v % 32)
+        self.threshold[node] = self.num_cat  # index into cat_boundaries
         self.cat_boundaries.append(self.cat_boundaries[-1] + nwords)
         self.cat_threshold.extend(bits)
         self.num_cat += 1
