@@ -12,6 +12,7 @@ from .basic import Booster, Dataset, LightGBMError  # noqa: F401
 from .callback import (EarlyStopException, early_stopping,  # noqa: F401
                        log_evaluation, record_evaluation, reset_parameter)
 from .engine import CVBooster, cv, train  # noqa: F401
+from .log import register_logger  # noqa: F401
 
 __version__ = "0.1.0"
 
@@ -19,5 +20,5 @@ __all__ = [
     "Dataset", "Booster", "LightGBMError",
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException",
+    "reset_parameter", "EarlyStopException", "register_logger",
 ]

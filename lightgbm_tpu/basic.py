@@ -97,7 +97,9 @@ class Dataset:
                         f"dataset params {sorted(dropped)} are ignored when "
                         "loading a binary dataset file (binning is fixed)")
                 return
-            from .io.text_loader import load_svmlight_or_csv
+            from .io.text_loader import (load_svmlight_or_csv,
+                                         sidecar_init_score,
+                                         sidecar_position)
             data, file_label, file_weight, file_group = \
                 load_svmlight_or_csv(path, params or {})
             if label is None:
@@ -106,6 +108,10 @@ class Dataset:
                 weight = file_weight
             if group is None:
                 group = file_group
+            if init_score is None:
+                init_score = sidecar_init_score(path)
+            if position is None:
+                position = sidecar_position(path)
         self.data = _to_2d(data)
         self.label = label
         self.weight = weight
@@ -159,11 +165,13 @@ class Dataset:
             forced_bins = {int(e["feature"]): e["bin_upper_bound"]
                            for e in spec}
 
-        self._binned = BinnedDataset.from_matrix(
-            self.data, cfg, metadata=meta,
-            categorical_features=cat_indices,
-            feature_names=names, reference=ref_binned,
-            forced_bins=forced_bins)
+        from .timer import global_timer
+        with global_timer.timed("data/binning"):
+            self._binned = BinnedDataset.from_matrix(
+                self.data, cfg, metadata=meta,
+                categorical_features=cat_indices,
+                feature_names=names, reference=ref_binned,
+                forced_bins=forced_bins)
         return self
 
     def _feature_names(self) -> List[str]:
@@ -290,6 +298,8 @@ class Booster:
                 "Booster requires train_set, model_file or model_str")
 
         self.config = Config.from_params(self.params)
+        from . import log
+        log.set_verbosity(self.config.verbosity)
         train_set.params = {**train_set.params, **self.params}
         train_set.construct()
         self.train_set = train_set
@@ -305,6 +315,23 @@ class Booster:
                                                   objective)
         else:
             self._gbdt = create_boosting(self.config, binned, objective)
+
+    # ------------------------------------------------------------------
+    def _load_init_model(self, init_model) -> "Booster":
+        """Continued training from a model file / string / Booster
+        (ref: engine.py train init_model; boosting.cpp:74-90)."""
+        if isinstance(init_model, Booster):
+            loaded = load_model_from_string(init_model.model_to_string())
+        elif isinstance(init_model, LoadedModel):
+            loaded = init_model
+        elif isinstance(init_model, str):
+            with open(init_model) as fh:
+                loaded = load_model_from_string(fh.read())
+        else:
+            raise TypeError(
+                "init_model must be a Booster, LoadedModel, or filename")
+        self._gbdt.init_from_loaded(loaded)
+        return self
 
     # ------------------------------------------------------------------
     def add_valid(self, data: Dataset, name: str) -> "Booster":
@@ -483,6 +510,18 @@ class Booster:
 
     def set_network(self, machines, local_listen_port=12400,
                     listen_time_out=120, num_machines=1) -> "Booster":
+        """Record a machine list for multi-host training. Socket-based
+        collectives are replaced by XLA collectives over the device mesh
+        (parallel/mesh.py); multi-process runs must initialize
+        jax.distributed instead (parallel.distributed.init_distributed)
+        — a machine list alone cannot join processes, so setting one
+        here warns rather than silently doing nothing."""
+        from . import log
+        log.warning(
+            "set_network: TCP collectives are not used on TPU; for "
+            "multi-host training initialize jax.distributed "
+            "(lightgbm_tpu.parallel.distributed.init_distributed) — "
+            "the machine list is recorded for API compatibility only")
         self._network_params = dict(machines=machines,
                                     local_listen_port=local_listen_port,
                                     num_machines=num_machines)

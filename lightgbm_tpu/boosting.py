@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from .config import Config
 from .dataset import BinnedDataset
 from .learner import grow_tree, grow_tree_waved, replay_tree
+from .timer import global_timer
 from .objectives import ObjectiveFunction, create_objective
 from .ops import histogram as hist_ops
 from .ops.split import FeatureMeta, SplitHyperParams, leaf_output
@@ -242,11 +243,18 @@ class GBDT:
         self._hist_impl = hist_impl
         self._has_categorical = any(
             m.is_categorical for m in self.train_set.mappers)
+        # per-node randomness (extra-trees thresholds, by-node feature
+        # sampling; ref: config.h extra_trees, feature_fraction_bynode)
+        self._use_node_rand = (self.config.extra_trees or
+                               self.config.feature_fraction_bynode < 1.0)
+        self._extra_key = jax.random.PRNGKey(self.config.extra_seed)
         self._grow = jax.jit(functools.partial(
             self._grow_fn(), **self._grow_kwargs(),
             hist_dtype=jnp.float32, hist_impl=hist_impl,
             interaction_groups=self._interaction_groups,
-            has_categorical=self._has_categorical))
+            has_categorical=self._has_categorical,
+            extra_trees=bool(self.config.extra_trees),
+            ff_bynode=float(self.config.feature_fraction_bynode)))
         self._fused = None
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
@@ -406,7 +414,10 @@ class GBDT:
                                  hist_dtype=jnp.float32,
                                  hist_impl=self._hist_impl,
                                  interaction_groups=self._interaction_groups,
-                                 has_categorical=self._has_categorical)
+                                 has_categorical=self._has_categorical,
+                                 extra_trees=bool(self.config.extra_trees),
+                                 ff_bynode=float(
+                                     self.config.feature_fraction_bynode))
         goss = self.config.data_sample_strategy == "goss"
 
         def fused(bins_fm, valid_bins, obj_state, scores, sample_mask,
@@ -434,9 +445,14 @@ class GBDT:
                             jax.random.fold_in(key, 300 + k), grad, hess)
                     fmask = self._feature_mask_in_jit(
                         jax.random.fold_in(key, 200 + k))
+                    node_key = (jax.random.fold_in(
+                        self._extra_key,
+                        it * self.num_tree_per_iteration + k)
+                        if self._use_node_rand else None)
                     rec, row_leaf = grow(bins_fm, grad, hess, mask, fmask,
                                          self.feature_meta, self.hp,
-                                         self.max_depth, self._forced)
+                                         self.max_depth, self._forced,
+                                         node_key)
                     if self.config.use_quantized_grad and \
                             self.config.quant_train_renew_leaf:
                         rec = self._renew_leaves_in_jit(
@@ -458,7 +474,15 @@ class GBDT:
                 else:
                     stacked = jax.tree_util.tree_map(
                         lambda *xs: jnp.stack(xs), *recs)
-                return scores, sample_mask, tuple(new_valid), stacked
+                # updated objective state: objectives that evolve device
+                # state across iterations (e.g. lambdarank position
+                # biases) assign tracers to their attributes during the
+                # trace; collecting the state here returns the updates
+                # as program outputs instead of losing them at restore
+                out_state = (obj.device_state() if obj is not None
+                             else {"arrays": {}, "sub": {}})
+                return (scores, sample_mask, tuple(new_valid), stacked,
+                        out_state)
             finally:
                 if obj is not None:
                     obj.swap_device_state(old_state)
@@ -468,11 +492,17 @@ class GBDT:
     def _train_one_iter_fast(self) -> bool:
         self._boost_from_average()
         if self._fused is None:
-            self._fused = self._make_fused()
-        self.scores, self._sample_mask, valid, recs = self._fused(
-            self.bins_fm, tuple(self._valid_bins), self._obj_state(),
-            self.scores, self._sample_mask, tuple(self._valid_scores),
-            jnp.int32(self.iter), jnp.float32(self.shrinkage_rate))
+            with global_timer.timed("train/compile_fused"):
+                self._fused = self._make_fused()
+        with global_timer.timed("train/iteration",
+                                block=lambda: self.scores):
+            (self.scores, self._sample_mask, valid, recs,
+             new_obj_state) = self._fused(
+                self.bins_fm, tuple(self._valid_bins), self._obj_state(),
+                self.scores, self._sample_mask, tuple(self._valid_scores),
+                jnp.int32(self.iter), jnp.float32(self.shrinkage_rate))
+        if self.objective is not None:
+            self.objective.swap_device_state(new_obj_state)
         self._valid_scores = list(valid)
         self._device_records.append(recs)
         self._record_lrs.append(self.shrinkage_rate)
@@ -482,6 +512,10 @@ class GBDT:
     def _materialize_records(self) -> None:
         if not self._device_records:
             return
+        with global_timer.timed("train/materialize_trees"):
+            self._materialize_records_inner()
+
+    def _materialize_records_inner(self) -> None:
         recs, lrs = self._device_records, self._record_lrs
         self._device_records, self._record_lrs = [], []
         if len(recs) == 1:
@@ -628,9 +662,14 @@ class GBDT:
                 grad, hess = self._discretize_in_jit(qkey, grad, hess)
             feature_mask = self._feature_mask()
 
+            node_key = (jax.random.fold_in(
+                self._extra_key,
+                self.iter * self.num_tree_per_iteration + k)
+                if self._use_node_rand else None)
             record, row_leaf = self._grow(
                 self.bins_fm, grad, hess, mask, feature_mask,
-                self.feature_meta, self.hp, self.max_depth, self._forced)
+                self.feature_meta, self.hp, self.max_depth, self._forced,
+                node_key)
             if self.config.use_quantized_grad and \
                     self.config.quant_train_renew_leaf:
                 record = self._renew_leaves_in_jit(
@@ -739,6 +778,59 @@ class GBDT:
         return np.asarray(self._valid_scores[idx]).T
 
     # ------------------------------------------------------------------
+    def init_from_loaded(self, loaded) -> None:
+        """Continued training: seed the booster with a previously trained
+        model's trees and fast-forward train/valid scores by prediction
+        (ref: boosting.cpp:74-90 LoadFileToBoosting; continued-training
+        init score via Predictor, application.cpp:92-100)."""
+        k = self.num_tree_per_iteration
+        if loaded.num_tree_per_iteration != k:
+            raise ValueError(
+                f"init_model has {loaded.num_tree_per_iteration} trees per "
+                f"iteration, training config needs {k}")
+        n_feat = self.train_set.num_total_features
+        if loaded.max_feature_idx + 1 > n_feat:
+            raise ValueError(
+                f"init_model uses {loaded.max_feature_idx + 1} features, "
+                f"train data has {n_feat}")
+        if self.train_set.raw_data is None:
+            raise ValueError(
+                "continued training requires raw feature values to "
+                "fast-forward scores (binary-loaded datasets keep none)")
+        trees = list(loaded.trees)
+        self._materialize_records()
+        self._host_models = [trees[i:i + k]
+                             for i in range(0, len(trees), k)]
+        self.iter = len(self._host_models)
+        # the loaded first tree already carries the boost-from-average
+        # bias; never re-apply it
+        self._init_done = True
+        self.init_scores = [0.0] * k
+
+        def _dataset_init_offset(meta_init, n):
+            """Per-row init_score offsets a dataset contributes to its
+            scores (same layout handling as __init__)."""
+            off = np.zeros((k, n), np.float32)
+            if meta_init is not None:
+                init = np.asarray(meta_init, np.float64)
+                if init.size == n * k:
+                    off += init.reshape(k, n, order="C").astype(np.float32)
+                else:
+                    off += init.reshape(1, -1).astype(np.float32)
+            return off
+
+        raw = self.predict_raw(np.asarray(self.train_set.raw_data,
+                                          np.float64))  # [N, K]
+        self.scores = jnp.asarray(
+            raw.T.astype(np.float32) + _dataset_init_offset(
+                self.train_set.metadata.init_score, self.num_data))
+        for i, (vs, raw_v) in enumerate(self._valid_sets):
+            vraw = self.predict_raw(np.asarray(raw_v, np.float64))
+            self._valid_scores[i] = jnp.asarray(
+                vraw.T.astype(np.float32) + _dataset_init_offset(
+                    vs.metadata.init_score, vs.num_data))
+
+    # ------------------------------------------------------------------
     def rollback_one_iter(self) -> None:
         """(ref: gbdt.cpp:463 RollbackOneIter)"""
         if self.iter <= 0:
@@ -830,18 +922,10 @@ class GBDT:
             return np.zeros((data.shape[0], self.num_tree_per_iteration))
         if any(t.is_linear for t in trees):
             return self._predict_raw_host(data, start_iteration, end)
-        from .ops.predict import pack_ensemble, predict_raw_multiclass
+        from .ops.predict import predict_raw_cached
         key = (start_iteration, end, self.current_iteration())
-        if getattr(self, "_packed_key", None) != key:
-            self._packed = pack_ensemble(trees, self.num_tree_per_iteration)
-            self._packed_key = key
-        n = data.shape[0]
-        outs = []
-        for lo in range(0, n, self._PREDICT_CHUNK):
-            x = jnp.asarray(data[lo:lo + self._PREDICT_CHUNK], jnp.float32)
-            outs.append(np.asarray(
-                predict_raw_multiclass(self._packed, x), np.float64))
-        return np.concatenate(outs, axis=0)
+        return predict_raw_cached(self, trees, self.num_tree_per_iteration,
+                                  data, key, self._PREDICT_CHUNK)
 
     def _predict_raw_host(self, data: np.ndarray, start_iteration: int,
                           end: int) -> np.ndarray:
@@ -923,20 +1007,34 @@ class DART(GBDT):
     def __init__(self, config, train_set, objective=None):
         super().__init__(config, train_set, objective)
         self._drop_rng = np.random.RandomState(config.drop_seed)
-        self._tree_weights: List[float] = []  # per iteration
+        # per-NEW-iteration weights used by weighted drop selection
+        # (ref: dart.hpp:200 tree_weight_, :68 push_back(shrinkage_rate_))
+        self._tree_weights: List[float] = []
+        self._sum_tree_weight = 0.0
+        self._num_init_iteration = 0
+
+    def init_from_loaded(self, loaded) -> None:
+        super().init_from_loaded(loaded)
+        # loaded trees are never dropped (ref: dart.hpp num_init_iteration_)
+        self._num_init_iteration = len(self._host_models)
 
     def _tree_shrinkage(self) -> float:
         return 1.0  # DART applies normalization itself (dart.hpp Normalize)
 
     def train_one_iter(self, custom_grad=None, custom_hess=None) -> bool:
-        drop_idx = self._select_drop(len(self.models))
+        drop_idx = self._select_drop()
         # subtract dropped trees from scores (dart.hpp DroppingTrees)
         for di in drop_idx:
             self._add_tree_scores(self.models[di], sign=-1.0)
 
         stop = super().train_one_iter(custom_grad, custom_hess)
         if not stop:
-            self._normalize(drop_idx)
+            new_factor = self._normalize(drop_idx)
+            # the new tree's weight is its actual applied factor
+            # (ref: dart.hpp:68 push_back(shrinkage_rate_) where
+            # shrinkage_rate_ was updated by DroppingTrees :139-147)
+            self._tree_weights.append(new_factor)
+            self._sum_tree_weight += new_factor
         for di in drop_idx:
             self._add_tree_scores(self.models[di], sign=1.0)
         return stop
@@ -951,36 +1049,55 @@ class DART(GBDT):
                 self._valid_scores[i] = self._valid_scores[i].at[k].add(
                     jnp.asarray(sign * tree.predict(raw).astype(np.float32)))
 
-    def _select_drop(self, n_models: int) -> List[int]:
+    def _select_drop(self) -> List[int]:
+        """Select iterations to drop (ref: dart.hpp:98 DroppingTrees).
+        Weighted mode drops tree i with probability proportional to its
+        current weight (ref: dart.hpp:104-116); weights shrink as trees
+        get renormalized away (Normalize), so frequently-dropped trees
+        become less likely to be dropped again."""
         cfg = self.config
-        if n_models == 0:
+        n_new = len(self.models) - self._num_init_iteration
+        if n_new == 0:
             return []
-        if cfg.uniform_drop:
-            sel = [i for i in range(n_models)
-                   if self._drop_rng.rand() < cfg.drop_rate]
-        else:
-            sel = [i for i in range(n_models)
-                   if self._drop_rng.rand() < cfg.drop_rate]
-        if len(sel) > cfg.max_drop > 0:
-            sel = list(self._drop_rng.choice(sel, cfg.max_drop, replace=False))
         if self._drop_rng.rand() < cfg.skip_drop:
             return []
-        return sorted(int(i) for i in sel)
+        drop_rate = cfg.drop_rate
+        sel: List[int] = []
+        if not cfg.uniform_drop:
+            sum_w = max(self._sum_tree_weight, 1e-30)
+            inv_avg = n_new / sum_w
+            if cfg.max_drop > 0:
+                drop_rate = min(drop_rate, cfg.max_drop * inv_avg / sum_w)
+            for i in range(n_new):
+                if self._drop_rng.rand() < \
+                        drop_rate * self._tree_weights[i] * inv_avg:
+                    sel.append(self._num_init_iteration + i)
+                    if cfg.max_drop > 0 and len(sel) >= cfg.max_drop:
+                        break
+        else:
+            if cfg.max_drop > 0:
+                drop_rate = min(drop_rate, cfg.max_drop / n_new)
+            for i in range(n_new):
+                if self._drop_rng.rand() < drop_rate:
+                    sel.append(self._num_init_iteration + i)
+                    if cfg.max_drop > 0 and len(sel) >= cfg.max_drop:
+                        break
+        return sel
 
-    def _normalize(self, drop_idx: List[int]) -> None:
-        """(ref: dart.hpp:159 Normalize)"""
+    def _normalize(self, drop_idx: List[int]) -> float:
+        """Scale the new tree by the DART shrinkage and the dropped trees
+        to k/(k+1) (or k/(k+lr) in xgboost mode) of their old weight
+        (ref: dart.hpp:159 Normalize + shrinkage_rate_ update :139-147).
+        Returns the new tree's applied factor."""
         k_drop = len(drop_idx)
         lr = self.config.learning_rate
         new_trees = self.models[-1]
         if self.config.xgboost_dart_mode:
-            new_factor = lr / (1.0 + lr)
-            old_factor = 1.0 / (1.0 + lr)
+            new_factor = lr if k_drop == 0 else lr / (lr + k_drop)
+            old_factor = k_drop / (k_drop + lr)
         else:
-            if k_drop == 0:
-                new_factor, old_factor = lr, 1.0
-            else:
-                new_factor = lr / (k_drop + lr)
-                old_factor = k_drop / (k_drop + lr)
+            new_factor = lr / (1.0 + k_drop)
+            old_factor = k_drop / (k_drop + 1.0)
         for k, tree in enumerate(new_trees):
             # shrink the new tree
             delta = (new_factor - 1.0)
@@ -992,10 +1109,23 @@ class DART(GBDT):
                     jnp.asarray((tree.predict(raw) * delta)
                                 .astype(np.float32)))
             tree.apply_shrinkage(new_factor)
-        # scale the dropped trees
+        # scale the dropped trees + their drop weights
+        # (ref: dart.hpp:159-196 Normalize weight bookkeeping)
         for di in drop_idx:
             for tree in self.models[di]:
                 tree.apply_shrinkage(old_factor)
+            if not self.config.uniform_drop:
+                wi = di - self._num_init_iteration
+                # mirror the reference's bookkeeping exactly, including
+                # its xgboost-mode quirk of subtracting w/(k+lr) rather
+                # than the true delta w*lr/(k+lr) (dart.hpp:175,193)
+                if self.config.xgboost_dart_mode:
+                    sub = 1.0 / (k_drop + lr)
+                else:
+                    sub = 1.0 / (k_drop + 1.0)
+                self._sum_tree_weight -= self._tree_weights[wi] * sub
+                self._tree_weights[wi] *= old_factor
+        return new_factor
 
 
 class RF(GBDT):

@@ -3,6 +3,7 @@
 from __future__ import annotations
 
 import collections
+from . import log
 from typing import Callable, Dict, List
 
 CallbackEnv = collections.namedtuple(
@@ -28,7 +29,7 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
             result = "\t".join(
                 f"{name}'s {metric}: {value:g}"
                 for name, metric, value, _ in env.evaluation_result_list)
-            print(f"[{env.iteration + 1}]\t{result}")
+            log.info(f"[{env.iteration + 1}]\t{result}")
     _callback.order = 10
     return _callback
 
@@ -92,8 +93,8 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             raise ValueError(
                 "For early stopping, at least one validation set is required")
         if verbose:
-            print(f"Training until validation scores don't improve for "
-                  f"{stopping_rounds} rounds")
+            log.info(f"Training until validation scores don't improve for "
+                     f"{stopping_rounds} rounds")
         n = len(env.evaluation_result_list)
         deltas = (min_delta if isinstance(min_delta, list)
                   else [min_delta] * n)
@@ -126,13 +127,13 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 continue
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:
-                    print(f"Early stopping, best iteration is:\n"
-                          f"[{best_iter[i] + 1}]")
+                    log.info(f"Early stopping, best iteration is:\n"
+                             f"[{best_iter[i] + 1}]")
                 raise EarlyStopException(best_iter[i], best_score_list[i])
             if env.iteration == env.end_iteration - 1:
                 if verbose:
-                    print(f"Did not meet early stopping. Best iteration is:\n"
-                          f"[{best_iter[i] + 1}]")
+                    log.info(f"Did not meet early stopping. Best iteration "
+                             f"is:\n[{best_iter[i] + 1}]")
                 raise EarlyStopException(best_iter[i], best_score_list[i])
     _callback.order = 30
     return _callback

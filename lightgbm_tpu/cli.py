@@ -121,6 +121,7 @@ class Application:
         booster = train_fn(dict(self.params), train_set,
                            num_boost_round=cfg.num_iterations,
                            valid_sets=valid_sets, valid_names=valid_names,
+                           init_model=cfg.input_model or None,
                            callbacks=callbacks)
         booster.save_model(cfg.output_model)
         if cfg.verbosity >= 0:

@@ -177,6 +177,10 @@ for _canon, _al in _ALIASES.items():
     for _a in _al:
         _ALIAS_TO_CANONICAL.setdefault(_a, _canon)
 
+# keys already warned about as unsupported, process-wide (Booster and
+# Dataset both build Configs from overlapping dicts; warn once per key)
+_WARNED_UNSUPPORTED: set = set()
+
 # Objective aliases (ref: config.h:136-160 objective name variants).
 _OBJECTIVE_ALIASES = {
     "regression": "regression",
@@ -424,9 +428,14 @@ class Config:
     tpu_num_shards: int = 0  # 0 = use all local devices for data-parallel learner
     tpu_donate_buffers: bool = True
     # waved leaf-wise growth: batch histogram builds of up to this many
-    # splits into one multi-leaf pass (0 = exact per-split builds; the
-    # early waves are exact either way — see learner.grow_tree_waved)
-    tpu_wave_max: int = 0
+    # splits into one multi-leaf pass (0 = exact per-split builds).
+    # Wave sizes follow a frontier-proportional schedule — see
+    # learner._wave_schedule — so early splits stay near-exact; the cap
+    # only bounds the LATE waves. Default 42 = the multi-leaf kernel's
+    # slot count (128 MXU lanes // 3 channels); ~13 full-data histogram
+    # passes per 255-leaf tree instead of 254, at quality parity
+    # (tests/test_waved.py).
+    tpu_wave_max: int = 42
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -457,7 +466,48 @@ class Config:
                 setattr(self, key, _coerce(value, getattr(self, key)))
             else:
                 self.extra_params[key] = value
+        if not hasattr(self, "explicit_keys"):
+            self.explicit_keys = set()
+        new_keys = set(canon_params) - self.explicit_keys
+        self.explicit_keys.update(canon_params)
         self._post_process()
+        # warn only for keys newly set by THIS update: Booster and
+        # Dataset each build a Config from overlapping param dicts and
+        # the warning should fire once per distinct user setting
+        self._warn_unsupported(new_keys)
+
+    # params that are accepted (for config compatibility) but have no
+    # effect in this build; explicitly setting one warns instead of
+    # silently no-oping. Audited by tests/test_param_honesty.py.
+    _UNSUPPORTED_EXPLICIT = {
+        "enable_bundle": "EFB feature bundling is not implemented; the "
+                         "dense [F, N] bin layout stores every feature "
+                         "unbundled",
+        "two_round": "two-round loading is not needed (single in-memory "
+                     "binning pass)",
+        "pre_partition": "pre-partitioned loading is not implemented",
+        "gpu_platform_id": "OpenCL params are ignored on TPU",
+        "gpu_device_id": "OpenCL params are ignored on TPU",
+        "gpu_use_dp": "OpenCL params are ignored on TPU",
+        "num_gpu": "multi-device training uses the TPU mesh "
+                   "(tpu_num_shards), not num_gpu",
+    }
+
+    def _warn_unsupported(self, new_keys) -> None:
+        from . import log
+        for key, msg in self._UNSUPPORTED_EXPLICIT.items():
+            if key in new_keys and key not in _WARNED_UNSUPPORTED:
+                _WARNED_UNSUPPORTED.add(key)
+                log.warning(f"{key} has no effect: {msg}")
+        if "monotone_constraints_method" in new_keys and \
+                str(self.monotone_constraints_method) in (
+                    "intermediate", "advanced") and \
+                "monotone_constraints_method" not in _WARNED_UNSUPPORTED:
+            _WARNED_UNSUPPORTED.add("monotone_constraints_method")
+            log.warning(
+                "monotone_constraints_method="
+                f"{self.monotone_constraints_method} is not implemented; "
+                "falling back to 'basic' bound propagation")
 
     def _post_process(self) -> None:
         self.objective = _OBJECTIVE_ALIASES.get(str(self.objective).lower(), self.objective)

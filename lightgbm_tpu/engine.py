@@ -34,8 +34,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
 
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
-        raise LightGBMError(
-            "continued training (init_model) not yet supported in round 1")
+        booster._load_init_model(init_model)
 
     valid_sets = valid_sets or []
     valid_names = valid_names or [f"valid_{i}" for i in range(len(valid_sets))]

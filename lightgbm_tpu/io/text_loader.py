@@ -122,6 +122,35 @@ def _sidecar_weight(path: str) -> Optional[np.ndarray]:
     return None
 
 
+def sidecar_init_score(path: str) -> Optional[np.ndarray]:
+    """<data>.init initial scores (ref: metadata.cpp:763-766
+    LoadInitialScore auto-detects the sidecar). Multi-column files
+    (multiclass) are returned class-major [k*N + i] as the reference
+    stores them (metadata.cpp SetInitScore layout), which is what
+    GBDT.__init__'s reshape(K, N) expects."""
+    ifile = path + ".init"
+    if os.path.exists(ifile):
+        return np.loadtxt(ifile, dtype=np.float64, ndmin=2).T.reshape(-1)
+    return None
+
+
+def sidecar_position(path: str) -> Optional[np.ndarray]:
+    """<data>.position per-row positions for position-bias ranking
+    (ref: metadata.cpp:735-741 LoadPositions — position entries are
+    arbitrary strings mapped to dense ids by first appearance)."""
+    pfile = path + ".position"
+    if not os.path.exists(pfile):
+        return None
+    with open(pfile) as fh:
+        entries = [ln.strip() for ln in fh if ln.strip()]
+    try:
+        return np.asarray([int(e) for e in entries], np.int64)
+    except ValueError:
+        ids: Dict[str, int] = {}
+        return np.asarray([ids.setdefault(e, len(ids)) for e in entries],
+                          np.int64)
+
+
 def _sidecar_group(path: str) -> Optional[np.ndarray]:
     qfile = path + ".query"
     if os.path.exists(qfile):

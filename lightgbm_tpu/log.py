@@ -1,0 +1,89 @@
+"""Logging facade (ref: include/LightGBM/utils/log.h:89 `Log`,
+python-package register_logger in basic.py).
+
+Levels mirror the reference (Fatal < Warning < Info < Debug); the
+threshold is driven by Config.verbosity exactly as the reference maps it
+(config.h verbosity: <0 fatal, 0 warning+error, 1 info, >1 debug). A
+custom logger object or callback can be registered, as with
+``lightgbm.register_logger``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+FATAL = -1
+WARNING = 0
+INFO = 1
+DEBUG = 2
+
+_LEVEL_NAMES = {FATAL: "Fatal", WARNING: "Warning", INFO: "Info",
+                DEBUG: "Debug"}
+
+_level = INFO
+_logger: Optional[Any] = None
+_info_method = "info"
+_warning_method = "warning"
+
+
+def set_verbosity(verbosity: int) -> None:
+    """Map Config.verbosity onto the log threshold
+    (ref: c_api.cpp LGBM_BoosterResetParameter verbosity handling)."""
+    global _level
+    if verbosity < 0:
+        _level = FATAL
+    elif verbosity == 0:
+        _level = WARNING
+    elif verbosity == 1:
+        _level = INFO
+    else:
+        _level = DEBUG
+
+
+def register_logger(logger: Any, info_method_name: str = "info",
+                    warning_method_name: str = "warning") -> None:
+    """Replace the default print-based output with a custom logger
+    (ref: python-package/lightgbm/basic.py register_logger)."""
+    for name in (info_method_name, warning_method_name):
+        if not callable(getattr(logger, name, None)):
+            raise TypeError(
+                f"Logger must provide a callable {name}() method")
+    global _logger, _info_method, _warning_method
+    _logger = logger
+    _info_method = info_method_name
+    _warning_method = warning_method_name
+
+
+def _emit(level: int, msg: str) -> None:
+    if level > _level:
+        return
+    if _logger is not None:
+        meth = _warning_method if level <= WARNING else _info_method
+        getattr(_logger, meth)(msg)
+    else:
+        print(f"[LightGBM-TPU] [{_LEVEL_NAMES[level]}] {msg}", flush=True)
+
+
+def debug(msg: str) -> None:
+    _emit(DEBUG, msg)
+
+
+def info(msg: str) -> None:
+    _emit(INFO, msg)
+
+
+def warning(msg: str) -> None:
+    _emit(WARNING, msg)
+
+
+def fatal(msg: str) -> None:
+    """Log and raise (ref: Log::Fatal always throws, log.h:89)."""
+    _emit(FATAL, msg)
+    from .basic import LightGBMError
+    raise LightGBMError(msg)
+
+
+def check(condition: bool, msg: str = "check failed") -> None:
+    """CHECK macro analog (ref: utils/log.h:44)."""
+    if not condition:
+        fatal(msg)

@@ -116,13 +116,18 @@ class LoadedModel:
         data = np.asarray(data, np.float64)
         n = data.shape[0]
         k = self.num_tree_per_iteration
-        out = np.zeros((n, k))
         end = self.num_iterations if num_iteration < 0 else min(
             self.num_iterations, start_iteration + num_iteration)
-        for it in range(start_iteration, end):
-            for ki in range(k):
-                tree = self.trees[it * k + ki]
-                out[:, ki] += tree.predict(data)
+        trees = self.trees[start_iteration * k:end * k]
+        if not trees or any(t.is_linear for t in trees):
+            # host fallback: linear-tree leaf models live on host
+            out = np.zeros((n, k))
+            for i, tree in enumerate(trees):
+                out[:, i % k] += tree.predict(data)
+        else:
+            from .ops.predict import predict_raw_cached
+            key = (start_iteration, end, len(self.trees))
+            out = predict_raw_cached(self, trees, k, data, key)
         if self.average_output and end > start_iteration:
             out /= (end - start_iteration)
         return out

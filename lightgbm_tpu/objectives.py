@@ -476,6 +476,43 @@ class _RankingObjective(ObjectiveFunction):
         self.pad_idx = jnp.asarray(idx)
         self.pad_mask = jnp.asarray(mask)
         self.label_pad = jnp.asarray(self.label_np)[self.pad_idx] * self.pad_mask
+        # position-bias debiasing state (ref: rank_objective.hpp:45-99):
+        # per-position-id additive score bias, Newton-updated each
+        # iteration from the accumulated lambdas
+        positions = metadata.positions
+        self.has_position_bias = positions is not None
+        if self.has_position_bias:
+            uniq, inv = np.unique(np.asarray(positions, np.int64),
+                                  return_inverse=True)
+            self.num_position_ids = len(uniq)
+            self.position_ids = uniq
+            self.pos_index = jnp.asarray(inv.astype(np.int32))  # [N]
+            self.pos_biases = jnp.zeros(self.num_position_ids, jnp.float32)
+
+    def _adjusted_score(self, score):
+        """Score with the current position biases added before lambda
+        computation (ref: rank_objective.hpp:69-74 score_adjusted)."""
+        if not self.has_position_bias:
+            return score
+        return score + self.pos_biases[self.pos_index]
+
+    def _update_position_bias(self, grad, hess):
+        """Newton-Raphson update of per-position biases from the final
+        lambdas (ref: rank_objective.hpp:303 UpdatePositionBiasFactors).
+        Assigns self.pos_biases — inside a jit trace this produces a
+        tracer that the fused program returns as updated objective state."""
+        if not self.has_position_bias:
+            return
+        reg = self.config.lambdarank_position_bias_regularization
+        lr = self.config.learning_rate
+        p = self.num_position_ids
+        first = jnp.zeros(p, jnp.float32).at[self.pos_index].add(-grad)
+        second = jnp.zeros(p, jnp.float32).at[self.pos_index].add(-hess)
+        counts = jnp.zeros(p, jnp.float32).at[self.pos_index].add(1.0)
+        first = first - self.pos_biases * reg * counts
+        second = second - reg * counts
+        self.pos_biases = self.pos_biases + \
+            lr * first / (jnp.abs(second) + 0.001)
 
     def _scatter_back(self, grad_pad, hess_pad):
         n = self.num_data
@@ -522,7 +559,7 @@ class LambdarankNDCG(_RankingObjective):
         """Pairwise lambdarank over padded queries
         (ref: rank_objective.hpp:139 GetGradientsForOneQuery)."""
         sig = self.config.sigmoid
-        s_pad = score[self.pad_idx]  # [Q, S]
+        s_pad = self._adjusted_score(score)[self.pad_idx]  # [Q, S]
         s_pad = jnp.where(self.pad_mask > 0, s_pad, -jnp.inf)
         lab = self.label_np_pad_int()
         gain = self.label_gain[lab] * self.pad_mask  # [Q, S]
@@ -559,7 +596,12 @@ class LambdarankNDCG(_RankingObjective):
             grad_pad = grad_pad * scale
             hess_pad = hess_pad * scale
             del cnt
-        return self._scatter_back(grad_pad, hess_pad)
+        grad, hess = self._scatter_back(grad_pad, hess_pad)
+        # per-row weights scale the final lambdas
+        # (ref: rank_objective.hpp:80-86)
+        grad, hess = self._apply_weight(grad, hess)
+        self._update_position_bias(grad, hess)
+        return grad, hess
 
     def label_np_pad_int(self):
         return self._lab_pad_int
@@ -578,7 +620,7 @@ class RankXENDCG(_RankingObjective):
     def get_gradients(self, score):
         """Cross-entropy surrogate for NDCG
         (ref: rank_objective.hpp:385 RankXENDCG::GetGradientsForOneQuery)."""
-        s_pad = score[self.pad_idx]
+        s_pad = self._adjusted_score(score)[self.pad_idx]
         neg_inf = jnp.finfo(s_pad.dtype).min
         s_masked = jnp.where(self.pad_mask > 0, s_pad, neg_inf)
         rho = jax.nn.softmax(s_masked, axis=1) * self.pad_mask  # [Q, S]
@@ -589,7 +631,10 @@ class RankXENDCG(_RankingObjective):
         # first/second order terms of the XE-NDCG loss
         grad_pad = (rho - phi) * self.pad_mask
         hess_pad = rho * (1.0 - rho) * self.pad_mask
-        return self._scatter_back(grad_pad, hess_pad)
+        grad, hess = self._scatter_back(grad_pad, hess_pad)
+        grad, hess = self._apply_weight(grad, hess)
+        self._update_position_bias(grad, hess)
+        return grad, hess
 
 
 # ---------------------------------------------------------------------------

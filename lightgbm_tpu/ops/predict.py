@@ -172,6 +172,28 @@ def predict_leaf_index(ens: PackedEnsemble, x: jax.Array) -> jax.Array:
     return jnp.swapaxes(leaves, 0, 1)
 
 
+def predict_raw_cached(owner, trees: List, num_tree_per_iteration: int,
+                       data: np.ndarray, cache_key,
+                       chunk: int = 1 << 20) -> np.ndarray:
+    """Raw [N, K] prediction through the packed device ensemble, with the
+    packed tensors cached on `owner` under `cache_key`. GBDT and
+    LoadedModel (model_io.py) both predict through this helper, so a
+    save/load round trip runs the identical XLA program and returns
+    bit-equal outputs (the reference gets the same property by sharing
+    GBDT::PredictRaw between live and loaded boosters,
+    gbdt_prediction.cpp:16)."""
+    if getattr(owner, "_packed_key", None) != cache_key:
+        owner._packed = pack_ensemble(trees, num_tree_per_iteration)
+        owner._packed_key = cache_key
+    n = data.shape[0]
+    outs = []
+    for lo in range(0, n, chunk):
+        x = jnp.asarray(data[lo:lo + chunk], jnp.float32)
+        outs.append(np.asarray(predict_raw_multiclass(owner._packed, x),
+                               np.float64))
+    return np.concatenate(outs, axis=0)
+
+
 def predict_raw_multiclass(ens: PackedEnsemble, x: jax.Array) -> jax.Array:
     """-> [B, K] for K = num_trees_per_class class streams."""
     k = ens.num_trees_per_class

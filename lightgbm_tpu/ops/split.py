@@ -188,13 +188,18 @@ def _gain_tensors(hist: jax.Array,
                   min_bound,
                   max_bound,
                   depth,
-                  has_categorical: bool):
+                  has_categorical: bool,
+                  rand_bins=None):
     """NET candidate gains for every (feature, threshold, variant).
 
     Variants: A numerical/missing-right, B numerical/missing-left,
     C categorical one-hot, and (when has_categorical) D/E categorical
     sorted-subset scans in ascending/descending grad-ratio order
     (ref: feature_histogram.cpp:243-344 categorical branch).
+
+    rand_bins: optional [F] int32 — extra-trees mode: only this bin is a
+    numerical split candidate per feature (ref: feature_histogram.hpp:205
+    rand_threshold in BeforeNumerical, checked at :897,:995).
 
     Gains are net of (parent_gain + min_gain_to_split) with the monotone
     split penalty applied, so a positive entry is a strictly improving
@@ -268,11 +273,14 @@ def _gain_tensors(hist: jax.Array,
 
     is_cat = meta.is_categorical[:, None]
     base_valid_a = (t_idx < nb - 1) & ~is_cat
-    gains_a = eval_variant(left_a, parent[None, None, :] - left_a,
-                           base_valid_a, hp)
-
     has_nan = meta.missing_type[:, None] == MISSING_NAN
     base_valid_b = has_nan & (t_idx < nb - 2) & ~is_cat
+    if rand_bins is not None:
+        rand_ok = t_idx == rand_bins[:, None]
+        base_valid_a = base_valid_a & rand_ok
+        base_valid_b = base_valid_b & rand_ok
+    gains_a = eval_variant(left_a, parent[None, None, :] - left_a,
+                           base_valid_a, hp)
     gains_b = eval_variant(parent[None, None, :] - right_b, right_b,
                            base_valid_b, hp)
 
@@ -390,14 +398,16 @@ def find_best_split(hist: jax.Array,
                     min_bound=None,
                     max_bound=None,
                     depth=None,
-                    has_categorical: bool = True) -> SplitInfo:
+                    has_categorical: bool = True,
+                    rand_bins=None) -> SplitInfo:
     """Find the best split across all features for one leaf.
 
     hist: [F, B, 3]; parent_*: scalars; feature_mask: [F] bool (feature
     fraction / interaction constraints); parent_output: scalar output of
     the leaf being split (path smoothing); min_bound/max_bound: the
     leaf's output bounds from ancestor monotone splits; depth: the
-    leaf's depth (monotone penalty). Returns scalar SplitInfo.
+    leaf's depth (monotone penalty); rand_bins: optional [F] extra-trees
+    random thresholds. Returns scalar SplitInfo.
     """
     if parent_output is None:
         parent_output = jnp.float32(0.0)
@@ -411,7 +421,7 @@ def find_best_split(hist: jax.Array,
     gains, aux = _gain_tensors(
         hist, parent_sum_grad, parent_sum_hess, parent_count, meta, hp,
         feature_mask, parent_output, min_bound, max_bound, depth,
-        has_categorical)
+        has_categorical, rand_bins)
     parent = aux["parent"]
     num_variants = gains.shape[-1]
     flat = gains.reshape(-1)

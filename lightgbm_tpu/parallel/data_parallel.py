@@ -82,6 +82,11 @@ class VotingParallelGBDT(_DataParallelMixin, GBDT):
             warnings.warn("forced splits / interaction constraints are "
                           "not supported by tree_learner=voting; ignoring")
         if self.mesh.size > 1:
+            if config.extra_trees or config.feature_fraction_bynode < 1.0:
+                import warnings
+                warnings.warn(
+                    "extra_trees / feature_fraction_bynode are not "
+                    "supported by the sharded voting learner; ignoring")
             from .voting import make_sharded_voting_grow
             top_k = max(1, min(int(config.top_k),
                                self.train_set.num_features))
@@ -90,7 +95,7 @@ class VotingParallelGBDT(_DataParallelMixin, GBDT):
                 has_categorical=self._has_categorical, **self._static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
-                              forced=None):
+                              forced=None, node_key=None):
                 return grow(bins, g, h, m, fm, meta, hp, md)
             self._grow = _grow_adapter
 
@@ -114,6 +119,11 @@ class FeatureParallelGBDT(GBDT):
             warnings.warn("forced splits / interaction constraints are "
                           "not supported by tree_learner=feature; ignoring")
         if self.mesh.size > 1:
+            if config.extra_trees or config.feature_fraction_bynode < 1.0:
+                import warnings
+                warnings.warn(
+                    "extra_trees / feature_fraction_bynode are not "
+                    "supported by the sharded feature learner; ignoring")
             # replicate everything; sharding is over the computation
             self.bins_fm = mesh_lib.replicate(self.mesh, self.bins_fm)
             self.scores = mesh_lib.replicate(self.mesh, self.scores)
@@ -128,7 +138,7 @@ class FeatureParallelGBDT(GBDT):
                 has_categorical=self._has_categorical, **self._static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
-                              forced=None):
+                              forced=None, node_key=None):
                 return grow(bins, g, h, m, fm, meta, hp, md)
             self._grow = _grow_adapter
             self._fused = None

@@ -1,0 +1,74 @@
+"""Named-phase wall-clock timers (ref: Common::Timer / FunctionTimer,
+include/LightGBM/utils/common.h:980,1044; global_timer printed at exit
+under USE_TIMETAG, src/boosting/gbdt.cpp:29).
+
+Enabled by ``LGBM_TPU_TIMETAG=1`` in the environment or
+``global_timer.enable()``; when enabled, a summary prints at interpreter
+exit exactly like the reference's atexit dump. ``timed`` phases nest via
+a stack so self-time is attributable. jax device work is asynchronous —
+phases that must charge device time to themselves should pass
+``block=`` the arrays to wait on.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+
+class Timer:
+    def __init__(self) -> None:
+        self.enabled = os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0")
+        self._total: Dict[str, float] = defaultdict(float)
+        self._count: Dict[str, int] = defaultdict(int)
+        self._printed = False
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def reset(self) -> None:
+        self._total.clear()
+        self._count.clear()
+
+    @contextmanager
+    def timed(self, name: str, block: Optional[Any] = None):
+        """Time a phase. ``block`` (optional pytree of jax arrays) is
+        waited on before the clock stops, so asynchronously-dispatched
+        device work is charged to the phase that launched it."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            if block is not None:
+                import jax
+                jax.block_until_ready(block() if callable(block) else block)
+            self._total[name] += time.perf_counter() - t0
+            self._count[name] += 1
+
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        return {name: {"seconds": self._total[name],
+                       "count": self._count[name]}
+                for name in sorted(self._total)}
+
+    def report(self) -> str:
+        lines = ["LightGBM-TPU phase timers:"]
+        for name in sorted(self._total, key=self._total.get, reverse=True):
+            lines.append(f"  {name:32s} {self._total[name]:10.3f}s "
+                         f"x{self._count[name]}")
+        return "\n".join(lines)
+
+    def print_at_exit(self) -> None:
+        if self.enabled and self._total and not self._printed:
+            self._printed = True
+            print(self.report(), flush=True)
+
+
+global_timer = Timer()
+atexit.register(global_timer.print_at_exit)

@@ -1,0 +1,85 @@
+"""Audit: no accepted-but-silently-ignored parameters.
+
+Every parameter the config accepts must either change behavior (tested
+by effect) or warn when explicitly set (tested by log capture). This
+guards the round-2 verdict's 'silent wrong-model territory' list:
+extra_trees, feature_fraction_bynode, DART weighted drop, enable_bundle,
+monotone_constraints_method, set_network.
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import log as log_mod
+from tests.conftest import make_binary
+
+
+class _Capture:
+    def __init__(self):
+        self.msgs = []
+
+    def info(self, m):
+        self.msgs.append(m)
+
+    def warning(self, m):
+        self.msgs.append(m)
+
+
+@pytest.fixture
+def captured_log():
+    from lightgbm_tpu import config as config_mod
+    config_mod._WARNED_UNSUPPORTED.clear()
+    log_mod.set_verbosity(1)  # earlier tests may have left level at fatal
+    cap = _Capture()
+    log_mod.register_logger(cap)
+    yield cap
+    log_mod._logger = None
+
+
+def _train(params, rounds=5):
+    X, y = make_binary(800)
+    return lgb.train({"objective": "binary", "num_leaves": 15,
+                      "min_data_in_leaf": 5, "verbosity": 0, **params},
+                     lgb.Dataset(X, label=y), num_boost_round=rounds), X
+
+
+def test_extra_trees_changes_model():
+    b0, X = _train({"verbosity": -1})
+    b1, _ = _train({"extra_trees": True, "verbosity": -1})
+    assert not np.allclose(b0.predict(X), b1.predict(X))
+
+
+def test_feature_fraction_bynode_changes_model():
+    b0, X = _train({"verbosity": -1})
+    b1, _ = _train({"feature_fraction_bynode": 0.4, "verbosity": -1})
+    assert not np.allclose(b0.predict(X), b1.predict(X))
+
+
+def test_dart_weighted_drop_differs_from_uniform():
+    common = {"boosting": "dart", "drop_rate": 0.5, "verbosity": -1}
+    b0, X = _train({**common, "uniform_drop": True}, rounds=10)
+    b1, _ = _train({**common, "uniform_drop": False}, rounds=10)
+    assert not np.allclose(b0.predict(X), b1.predict(X))
+
+
+def test_enable_bundle_warns(captured_log):
+    _train({"enable_bundle": True})
+    assert any("enable_bundle" in m for m in captured_log.msgs)
+
+
+def test_monotone_method_advanced_warns(captured_log):
+    _train({"monotone_constraints": [1, 0, 0, 0, 0, 0, 0, 0],
+            "monotone_constraints_method": "advanced"})
+    assert any("monotone_constraints_method" in m for m in captured_log.msgs)
+
+
+def test_set_network_warns(captured_log):
+    bst, _ = _train({})
+    bst.set_network(["host1:123", "host2:123"], num_machines=2)
+    assert any("set_network" in m for m in captured_log.msgs)
+
+
+def test_unset_params_do_not_warn(captured_log):
+    _train({})
+    assert not any("has no effect" in m for m in captured_log.msgs)
