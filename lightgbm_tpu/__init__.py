@@ -13,6 +13,9 @@ from .callback import (EarlyStopException, early_stopping,  # noqa: F401
                        log_evaluation, record_evaluation, reset_parameter)
 from .engine import CVBooster, cv, train  # noqa: F401
 from .log import register_logger  # noqa: F401
+from . import plotting  # noqa: F401
+from .plotting import (create_tree_digraph, plot_importance,  # noqa: F401
+                       plot_metric, plot_split_value_histogram, plot_tree)
 
 __version__ = "0.1.0"
 
@@ -21,4 +24,6 @@ __all__ = [
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "register_logger",
+    "plot_importance", "plot_metric", "plot_split_value_histogram",
+    "plot_tree", "create_tree_digraph", "plotting",
 ]

@@ -490,6 +490,24 @@ class Booster:
 
     def feature_importance(self, importance_type: str = "split",
                            iteration: int = -1) -> np.ndarray:
+        if self._loaded is not None:
+            # text-loaded model: accumulate over parsed trees, with the
+            # same dtype/semantics as the live path
+            # (ref: GBDT::FeatureImportance gbdt.cpp)
+            n = self._loaded.max_feature_idx + 1
+            out = np.zeros(n, np.float64)
+            trees = self._loaded.trees
+            if iteration > 0:
+                trees = trees[:iteration *
+                              max(self._loaded.num_tree_per_iteration, 1)]
+            for tree in trees:
+                for i in range(tree.num_internal):
+                    f = int(tree.split_feature[i])
+                    if importance_type == "gain":
+                        out[f] += max(float(tree.split_gain[i]), 0.0)
+                    else:
+                        out[f] += 1.0
+            return out
         return self._gbdt.feature_importance(importance_type, iteration)
 
     def reset_parameter(self, params: Dict[str, Any]) -> "Booster":

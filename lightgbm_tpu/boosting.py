@@ -81,6 +81,11 @@ class GBDT:
 
         # device-side constants
         self.bins_fm = train_set.device_bins()
+        # EFB (ref: dataset.cpp:251): bins_fm is bundled [G, N] storage;
+        # the growers decode through this triple (None when unbundled)
+        self._bundle = train_set.device_bundle()
+        self._num_bundle_bins = (train_set.bundle_info.num_bundle_bins
+                                 if train_set.bundle_info is not None else 0)
         num_bins, missing, default_bin, is_cat = \
             train_set.feature_meta_arrays()
         mono = np.zeros(train_set.num_features, np.int8)
@@ -251,6 +256,7 @@ class GBDT:
         self._grow = jax.jit(functools.partial(
             self._grow_fn(), **self._grow_kwargs(),
             hist_dtype=jnp.float32, hist_impl=hist_impl,
+            hist_precision=self.config.tpu_hist_precision,
             interaction_groups=self._interaction_groups,
             has_categorical=self._has_categorical,
             extra_trees=bool(self.config.extra_trees),
@@ -272,6 +278,9 @@ class GBDT:
         kw = dict(self._static)
         if self._use_waved():
             kw["wave_max"] = int(self.config.tpu_wave_max)
+        if self._bundle is not None:
+            kw["bundle"] = self._bundle
+            kw["num_bundle_bins"] = self._num_bundle_bins
         return kw
 
     # ------------------------------------------------------------------
@@ -375,7 +384,9 @@ class GBDT:
             u_g = u_h = 0.5
         g_int = jnp.trunc(grad / g_scale + jnp.sign(grad) * u_g)
         h_int = jnp.trunc(hess / h_scale + u_h)
-        return g_int * g_scale, h_int * h_scale
+        quant = (g_int, h_int, g_scale.astype(jnp.float32),
+                 h_scale.astype(jnp.float32))
+        return g_int * g_scale, h_int * h_scale, quant
 
     def _renew_leaves_in_jit(self, rec, row_leaf, true_grad, true_hess,
                              mask):
@@ -413,6 +424,7 @@ class GBDT:
         grow = functools.partial(self._grow_fn(), **self._grow_kwargs(),
                                  hist_dtype=jnp.float32,
                                  hist_impl=self._hist_impl,
+                                 hist_precision=self.config.tpu_hist_precision,
                                  interaction_groups=self._interaction_groups,
                                  has_categorical=self._has_categorical,
                                  extra_trees=bool(self.config.extra_trees),
@@ -440,8 +452,9 @@ class GBDT:
                             jax.random.fold_in(key, 100 + k), grad, hess)
                         grad, hess = grad * scale, hess * scale
                     true_grad, true_hess = grad, hess
+                    quant = None
                     if self.config.use_quantized_grad:
-                        grad, hess = self._discretize_in_jit(
+                        grad, hess, quant = self._discretize_in_jit(
                             jax.random.fold_in(key, 300 + k), grad, hess)
                     fmask = self._feature_mask_in_jit(
                         jax.random.fold_in(key, 200 + k))
@@ -449,10 +462,19 @@ class GBDT:
                         self._extra_key,
                         it * self.num_tree_per_iteration + k)
                         if self._use_node_rand else None)
+                    grow_kw = {}
+                    if quant is not None and self._use_waved() and \
+                            int(self.config.num_grad_quant_bins) <= 126:
+                        # int8 integer-histogram passes (the exact grower
+                        # consumes the dequantized f32 values instead).
+                        # |h_int| <= bins and |g_int| <= bins/2+1, so the
+                        # int8 cast is exact only for bins <= 126 — larger
+                        # settings stay on the f32 hist path
+                        grow_kw["quant"] = quant
                     rec, row_leaf = grow(bins_fm, grad, hess, mask, fmask,
                                          self.feature_meta, self.hp,
                                          self.max_depth, self._forced,
-                                         node_key)
+                                         node_key, **grow_kw)
                     if self.config.use_quantized_grad and \
                             self.config.quant_train_renew_leaf:
                         rec = self._renew_leaves_in_jit(
@@ -464,7 +486,7 @@ class GBDT:
                     scores = scores.at[k].add(leaf_vals[row_leaf])
                     for vi in range(len(valid_bins)):
                         vleaf = replay_tree(rec, valid_bins[vi],
-                                            self.feature_meta)
+                                            self.feature_meta, self._bundle)
                         new_valid[vi] = new_valid[vi].at[k].add(
                             leaf_vals[vleaf])
                     recs.append(rec)
@@ -478,8 +500,11 @@ class GBDT:
                 # state across iterations (e.g. lambdarank position
                 # biases) assign tracers to their attributes during the
                 # trace; collecting the state here returns the updates
-                # as program outputs instead of losing them at restore
-                out_state = (obj.device_state() if obj is not None
+                # as program outputs instead of losing them at restore.
+                # Evolving subset only — returning the full state would
+                # copy every constant [N] label/weight buffer per iter
+                out_state = (obj.device_state(evolving_only=True)
+                             if obj is not None
                              else {"arrays": {}, "sub": {}})
                 return (scores, sample_mask, tuple(new_valid), stacked,
                         out_state)
@@ -659,7 +684,7 @@ class GBDT:
             if self.config.use_quantized_grad:
                 qkey = jax.random.fold_in(self._bagging_key,
                                           self.iter + (3 << 20) + k)
-                grad, hess = self._discretize_in_jit(qkey, grad, hess)
+                grad, hess, _quant = self._discretize_in_jit(qkey, grad, hess)
             feature_mask = self._feature_mask()
 
             node_key = (jax.random.fold_in(
@@ -883,13 +908,20 @@ class GBDT:
                 if v >= 0 and v // 32 < hi - lo and \
                         (tree.cat_threshold[lo + v // 32] >> (v % 32)) & 1:
                     cat_lut[nd_i, b] = True
+        bi = self.train_set.bundle_info
         for _ in range(tree.num_internal + 1):
             if done.all():
                 break
             active = np.flatnonzero(~done)
             nd = node[active]
             feat = tree.split_feature_inner[nd]
-            b = bins[feat, active].astype(np.int32)
+            if bi is None:
+                b = bins[feat, active].astype(np.int32)
+            else:  # EFB decode
+                from .bundling import decode_stored_host
+                b = decode_stored_host(
+                    bins[bi.group_of[feat], active].astype(np.int32),
+                    bi.offset_of[feat], num_bins[feat] - 1)
             tbin = tree.threshold_bin[nd]
             nan_bin = num_bins[feat] - 1
             is_nan = (missing[feat] == 2) & (b == nan_bin)

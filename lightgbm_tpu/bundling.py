@@ -1,0 +1,177 @@
+"""Exclusive Feature Bundling (EFB) — the wide-sparse data path.
+
+TPU-native re-think of the reference's FeatureGroup/EFB machinery
+(ref: src/io/dataset.cpp:112 FindGroups, :251 FastFeatureBundling,
+include/LightGBM/feature_group.h:27). The reference bundles mutually
+exclusive features so one Bin column stores many features. On TPU the
+dense ``[F, N]`` bin tensor is the memory ceiling for wide one-hot data
+(10k features x 10M rows = 100 GB unbundled), so bundling compresses
+STORAGE to ``[G, N]`` with G = #bundles; histograms are built on the
+bundled columns and expanded back to the logical per-feature layout with
+a static gather, so the split finder and all tree semantics are
+unchanged.
+
+Encoding inside a bundle (ref: feature_group.h bin_offsets_): bundle bin
+0 = every member feature at its default bin; member f's non-default bins
+``1..nb_f-1`` occupy the half-open range ``[offset_f, offset_f+nb_f-1)``.
+The logical bin-0 row of each member's histogram is recovered as
+``leaf_total - sum(non-default bins)`` — exact for conflict-free
+bundles (and the bundler only merges conflict-free features unless
+`max_conflict_rate` allows otherwise, like the reference).
+"""
+
+from __future__ import annotations
+
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+
+class BundleInfo(NamedTuple):
+    """Static bundle structure (host). F = logical used features,
+    G = stored columns."""
+    bundles: Tuple[Tuple[int, ...], ...]  # member feature idxs per bundle
+    group_of: np.ndarray   # [F] int32: stored column of feature f
+    offset_of: np.ndarray  # [F] int32: bundle bin of f's logical bin 1
+    num_bundle_bins: int   # max bins over stored columns (B_tot)
+
+    @classmethod
+    def from_bundles(cls, bundles, num_bins) -> "BundleInfo":
+        """Derive the offset layout from bundle membership — the single
+        source of truth for the encoding (build + binary reload both
+        call this)."""
+        f = len(num_bins)
+        group_of = np.zeros(f, np.int32)
+        offset_of = np.zeros(f, np.int32)
+        widths = []
+        for g, members in enumerate(bundles):
+            off = 1
+            for feat in members:
+                group_of[feat] = g
+                offset_of[feat] = off
+                off += int(num_bins[feat]) - 1
+            widths.append(off)
+        return cls(bundles=tuple(tuple(m) for m in bundles),
+                   group_of=group_of, offset_of=offset_of,
+                   num_bundle_bins=max(widths) if widths else 1)
+
+
+def find_bundles(nonzero_masks: np.ndarray, num_bins: np.ndarray,
+                 *, max_conflict_rate: float = 0.0,
+                 max_bundle_bins: int = 256,
+                 bundleable: Optional[np.ndarray] = None) -> List[List[int]]:
+    """Greedy conflict-bounded grouping (ref: dataset.cpp:112 FindGroups).
+
+    nonzero_masks: [F, S] bool over the binning SAMPLE rows — True where
+    the feature is at a non-default bin. Features are scanned in
+    decreasing nonzero count (the reference's ordering) and placed into
+    the first bundle whose accumulated conflict count and total bin width
+    allow it. Features with `bundleable[f] == False` (e.g. default bin
+    != 0, which the offset encoding can't represent) are forced into
+    singleton bundles — stored verbatim.
+    """
+    f, s = nonzero_masks.shape
+    max_conflicts = int(max_conflict_rate * s)
+    order = np.argsort(-nonzero_masks.sum(axis=1, dtype=np.int64))
+
+    bundle_members: List[List[int]] = []
+    bundle_masks: List[np.ndarray] = []
+    bundle_conflicts: List[int] = []
+    bundle_bins: List[int] = []
+    for feat in order:
+        feat = int(feat)
+        width = int(num_bins[feat]) - 1  # non-default bins it adds
+        placed = False
+        if bundleable is None or bundleable[feat]:
+            for g in range(len(bundle_members)):
+                if bundle_masks[g] is None:  # singleton-only bundle
+                    continue
+                if bundle_bins[g] + width + 1 > max_bundle_bins:
+                    continue
+                conflicts = int(np.sum(bundle_masks[g] & nonzero_masks[feat]))
+                if bundle_conflicts[g] + conflicts <= max_conflicts:
+                    bundle_members[g].append(feat)
+                    bundle_masks[g] = bundle_masks[g] | nonzero_masks[feat]
+                    bundle_conflicts[g] += conflicts
+                    bundle_bins[g] += width
+                    placed = True
+                    break
+        if not placed:
+            bundle_members.append([feat])
+            bundle_masks.append(
+                nonzero_masks[feat].copy()
+                if (bundleable is None or bundleable[feat]) else None)
+            bundle_conflicts.append(0)
+            bundle_bins.append(width + 1)
+    return bundle_members
+
+
+def build_bundled_matrix(bins_fm: np.ndarray, num_bins: np.ndarray,
+                         bundles: List[List[int]]
+                         ) -> Tuple[np.ndarray, BundleInfo]:
+    """Merge a logical [F, N] bin matrix into stored [G, N] columns.
+
+    Rows with several non-default members in one bundle (conflicts, when
+    max_conflict_rate > 0) keep the LAST member's code, like the
+    reference's push order.
+    """
+    f, n = bins_fm.shape
+    info = BundleInfo.from_bundles(bundles, num_bins)
+    dtype = np.uint8 if info.num_bundle_bins <= 256 else np.uint16
+    out = np.zeros((len(bundles), n), dtype)
+    for g, members in enumerate(bundles):
+        col = np.zeros(n, np.int64)
+        for feat in members:
+            fb = bins_fm[feat].astype(np.int64)
+            nz = fb > 0
+            col[nz] = info.offset_of[feat] + fb[nz] - 1
+        out[g] = col.astype(dtype)
+    return out, info
+
+
+def should_bundle(bundles: List[List[int]], num_features: int) -> bool:
+    """Bundling pays when it actually shrinks the matrix (ref:
+    dataset.cpp FastFeatureBundling only groups when beneficial)."""
+    return len(bundles) < num_features
+
+
+# ----------------------------------------------------------------------
+# logical views. Device-side decode lives in ops/partition.feature_bins
+# (the jit-traced twin of this helper); keep the two in sync.
+
+
+def decode_stored_host(col_stored: np.ndarray, offset: np.ndarray,
+                       width: np.ndarray) -> np.ndarray:
+    """Host decode of stored bundle codes to logical bins (vectorized
+    over rows with per-row offsets/widths): stored in
+    [off, off+width) -> stored - off + 1; else default 0."""
+    in_range = (col_stored >= offset) & (col_stored < offset + width)
+    return np.where(in_range, col_stored - offset + 1, 0)
+
+
+def expand_bundle_hist(bundle_hist, group_of, offset_of, nb,
+                       max_bins: int, totals):
+    """[..., G, B_tot, C] bundled histogram -> [..., F, B, C] logical.
+
+    nb: [F] logical bin counts; totals: [..., C] per-leaf channel totals
+    (each feature's default-bin row = total - sum of its own non-default
+    bins). Rows b >= nb[f] contain neighboring features' bins — the
+    split finder masks them via FeatureMeta.num_bins, and the bin-0
+    subtraction here masks them explicitly.
+    """
+    import jax.numpy as jnp
+    b_tot = bundle_hist.shape[-2]
+    # gather non-default bins: logical (f, b >= 1) <- bundled
+    # (group_of[f], offset_of[f] + b - 1)
+    bidx = jnp.arange(max_bins)  # [B]
+    src_bin = jnp.clip(offset_of[:, None] + bidx[None, :] - 1, 0, b_tot - 1)
+    gathered = bundle_hist[..., group_of, :, :]  # [..., F, B_tot, C]
+    idx = jnp.broadcast_to(
+        src_bin[..., None],
+        gathered.shape[:-2] + (max_bins, gathered.shape[-1]))
+    hist = jnp.take_along_axis(gathered, idx, axis=-2)  # [..., F, B, C]
+    own = (bidx[None, :] >= 1) & (bidx[None, :] < nb[:, None])  # [F, B]
+    nondefault = jnp.sum(hist * own[..., None], axis=-2)  # [..., F, C]
+    default_row = totals[..., None, :] - nondefault
+    hist = hist.at[..., 0, :].set(default_row)
+    return hist

@@ -29,8 +29,13 @@ def log_evaluation(period: int = 1, show_stdv: bool = True) -> Callable:
             result = "\t".join(
                 f"{name}'s {metric}: {value:g}"
                 for name, metric, value, _ in env.evaluation_result_list)
-            log.info(f"[{env.iteration + 1}]\t{result}")
+            # user attached this callback explicitly: print regardless of
+            # the global verbosity gate (reference callbacks do the same)
+            log.info(f"[{env.iteration + 1}]\t{result}", force=True)
     _callback.order = 10
+    # per-iteration evals must run for this callback to have anything
+    # to print, even when metric_freq suppresses them
+    _callback.needs_eval = True
     return _callback
 
 
@@ -52,6 +57,7 @@ def record_evaluation(eval_result: Dict) -> Callable:
             eval_result.setdefault(name, collections.OrderedDict()) \
                 .setdefault(metric, []).append(value)
     _callback.order = 20
+    _callback.needs_eval = True
     return _callback
 
 
@@ -94,7 +100,7 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
                 "For early stopping, at least one validation set is required")
         if verbose:
             log.info(f"Training until validation scores don't improve for "
-                     f"{stopping_rounds} rounds")
+                     f"{stopping_rounds} rounds", force=True)
         n = len(env.evaluation_result_list)
         deltas = (min_delta if isinstance(min_delta, list)
                   else [min_delta] * n)
@@ -128,12 +134,15 @@ def early_stopping(stopping_rounds: int, first_metric_only: bool = False,
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:
                     log.info(f"Early stopping, best iteration is:\n"
-                             f"[{best_iter[i] + 1}]")
+                             f"[{best_iter[i] + 1}]", force=True)
                 raise EarlyStopException(best_iter[i], best_score_list[i])
             if env.iteration == env.end_iteration - 1:
                 if verbose:
                     log.info(f"Did not meet early stopping. Best iteration "
-                             f"is:\n[{best_iter[i] + 1}]")
+                             f"is:\n[{best_iter[i] + 1}]", force=True)
                 raise EarlyStopException(best_iter[i], best_score_list[i])
     _callback.order = 30
+    # engine.train forces per-iteration evals when this callback is
+    # present (the reference's early stopping ignores metric_freq too)
+    _callback.needs_eval = True
     return _callback

@@ -103,6 +103,7 @@ _ALIASES: Dict[str, List[str]] = {
     "data_random_seed": ["data_seed"],
     "is_enable_sparse": ["is_sparse", "enable_sparse", "sparse"],
     "enable_bundle": ["is_enable_bundle", "bundle"],
+    "max_conflict_rate": [],
     "use_missing": [],
     "zero_as_missing": [],
     "feature_pre_filter": [],
@@ -169,6 +170,7 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_num_shards": [],
     "tpu_donate_buffers": [],
     "tpu_wave_max": [],
+    "tpu_hist_precision": [],
 }
 
 _ALIAS_TO_CANONICAL: Dict[str, str] = {}
@@ -348,6 +350,7 @@ class Config:
     data_random_seed: int = 1
     is_enable_sparse: bool = True
     enable_bundle: bool = True
+    max_conflict_rate: float = 0.0
     use_missing: bool = True
     zero_as_missing: bool = False
     feature_pre_filter: bool = True
@@ -436,6 +439,15 @@ class Config:
     # passes per 255-leaf tree instead of 254, at quality parity
     # (tests/test_waved.py).
     tpu_wave_max: int = 42
+    # MXU precision of the histogram one-hot contraction: "default" =
+    # single bf16 pass with f32 accumulation (the one-hot operand is
+    # exact in bf16; the grad/hess operand is rounded to 8 mantissa
+    # bits — noise far below the gradient-quantization the reference
+    # itself ships with use_quantized_grad), "high" = 3-pass, "highest"
+    # = 6-pass f32 emulation. On CPU (tests) every mode is exact f32.
+    # Measured on the TPU chip: "default" matches "highest" AUC to
+    # ~1e-3 at Higgs shape while cutting iteration time ~2x.
+    tpu_hist_precision: str = "default"
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)
@@ -480,9 +492,6 @@ class Config:
     # effect in this build; explicitly setting one warns instead of
     # silently no-oping. Audited by tests/test_param_honesty.py.
     _UNSUPPORTED_EXPLICIT = {
-        "enable_bundle": "EFB feature bundling is not implemented; the "
-                         "dense [F, N] bin layout stores every feature "
-                         "unbundled",
         "two_round": "two-round loading is not needed (single in-memory "
                      "binning pass)",
         "pre_partition": "pre-partitioned loading is not implemented",
@@ -495,6 +504,11 @@ class Config:
 
     def _warn_unsupported(self, new_keys) -> None:
         from . import log
+        # self.verbosity is already set by this update(); honor it even
+        # before the Booster installs the global log level (verbosity=-1
+        # in the same params dict must silence these, like the reference)
+        if self.verbosity < 0:
+            return
         for key, msg in self._UNSUPPORTED_EXPLICIT.items():
             if key in new_keys and key not in _WARNED_UNSUPPORTED:
                 _WARNED_UNSUPPORTED.add(key)

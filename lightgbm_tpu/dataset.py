@@ -126,6 +126,10 @@ class BinnedDataset:
         # raw feature values [N, F_total] (reference kept for linear-tree
         # leaf fits; None for binary-loaded datasets)
         self.raw_data: Optional[np.ndarray] = None
+        # EFB: when set, bins_fm holds BUNDLED columns [G, N] and
+        # bundle_info maps logical features into them (ref:
+        # dataset.cpp:251 FastFeatureBundling; see bundling.py)
+        self.bundle_info = None
 
     # ------------------------------------------------------------------
     @property
@@ -134,7 +138,7 @@ class BinnedDataset:
 
     @property
     def num_features(self) -> int:
-        return self.bins_fm.shape[0]
+        return len(self.mappers)
 
     @property
     def max_bins(self) -> int:
@@ -171,10 +175,19 @@ class BinnedDataset:
             # (ref: dataset_loader.cpp:307 LoadFromFileAlignWithOtherDataset)
             mappers = reference.mappers
             used = reference.used_features
-            bins_fm = _transform_all(data, mappers, used,
-                                     reference.bins_fm.dtype)
+            logical_dtype = (np.uint8 if max(
+                (m.num_bins for m in mappers), default=1) <= 256
+                else np.uint16)
+            bins_fm = _transform_all(data, mappers, used, logical_dtype)
+            if reference.bundle_info is not None:
+                from .bundling import build_bundled_matrix
+                nb = np.array([m.num_bins for m in mappers], np.int64)
+                bins_fm, _ = build_bundled_matrix(
+                    bins_fm, nb, [list(b) for b in
+                                  reference.bundle_info.bundles])
             ds = cls(bins_fm, mappers, used, reference.num_total_features,
                      metadata, reference.feature_names)
+            ds.bundle_info = reference.bundle_info
             ds.raw_data = data
             return ds
 
@@ -217,15 +230,72 @@ class BinnedDataset:
         bins_fm = _transform_all(data, mappers, used, dtype)
         ds = cls(bins_fm, mappers, used, f, metadata, feature_names)
         ds.raw_data = data
+        if config.enable_bundle and len(mappers) > 1:
+            ds._try_bundle(config)
         return ds
+
+    def _try_bundle(self, config: Config) -> None:
+        """EFB: merge mutually exclusive features into bundled storage
+        columns when that shrinks the bin matrix (ref: dataset.cpp:112
+        FindGroups, :251 FastFeatureBundling). Logical semantics are
+        unchanged — histograms/partitions decode through bundle_info."""
+        from .bundling import (build_bundled_matrix, find_bundles,
+                               should_bundle)
+        if config.tree_learner not in ("serial",):
+            return  # parallel learners shard logical features directly
+        nb = np.array([m.num_bins for m in self.mappers], np.int64)
+        default_bins = np.array([m.default_bin for m in self.mappers],
+                                np.int64)
+        # conflict detection on a row SAMPLE (ref: FindGroups samples too)
+        # — a full scan would cost O(F*G*N) host time on exactly the
+        # wide-sparse data EFB exists for
+        n = self.bins_fm.shape[1]
+        sample_cnt = min(n, int(config.bin_construct_sample_cnt))
+        if sample_cnt < n:
+            rng = np.random.RandomState(config.data_random_seed)
+            rows = np.sort(rng.choice(n, sample_cnt, replace=False))
+            sample = self.bins_fm[:, rows]
+        else:
+            sample = self.bins_fm
+        nonzero = sample != default_bins[:, None].astype(self.bins_fm.dtype)
+        # the offset encoding represents "default" as stored bin 0, so
+        # only default-bin-0 features can share a bundle; others are
+        # stored verbatim as singletons
+        bundles = find_bundles(
+            nonzero, nb,
+            max_conflict_rate=float(config.max_conflict_rate),
+            max_bundle_bins=max(int(self.max_bins), 256),
+            bundleable=(default_bins == 0))
+        if not should_bundle(bundles, len(self.mappers)):
+            return
+        bundled, info = build_bundled_matrix(self.bins_fm, nb, bundles)
+        self.bins_fm = bundled
+        self.bundle_info = info
+        self._device_cache.clear()
 
     # ------------------------------------------------------------------
     def device_bins(self):
-        """Bin matrix as a device array (cached)."""
+        """Bin matrix as a device array (cached). Bundled storage when
+        bundle_info is set — pair with device_bundle()."""
         import jax.numpy as jnp
         key = "bins"
         if key not in self._device_cache:
             self._device_cache[key] = jnp.asarray(self.bins_fm)
+        return self._device_cache[key]
+
+    def device_bundle(self):
+        """(group_of, offset_of, num_bins) device triple for EFB decode,
+        or None for unbundled storage."""
+        if self.bundle_info is None:
+            return None
+        import jax.numpy as jnp
+        key = "bundle"
+        if key not in self._device_cache:
+            nb = np.array([m.num_bins for m in self.mappers], np.int32)
+            self._device_cache[key] = (
+                jnp.asarray(self.bundle_info.group_of),
+                jnp.asarray(self.bundle_info.offset_of),
+                jnp.asarray(nb))
         return self._device_cache[key]
 
     def feature_infos(self) -> List[str]:

@@ -65,8 +65,11 @@ def train(params: Dict[str, Any], train_set: Dataset,
         should_stop = booster.update()
 
         evaluation_result_list = []
+        needs_eval = any(getattr(cb, "needs_eval", False)
+                         for cb in callbacks_after)
         if (valid_sets or cfg.is_provide_training_metric) and \
-                cfg.metric_freq > 0 and (i + 1) % cfg.metric_freq == 0:
+                (needs_eval or (cfg.metric_freq > 0
+                                and (i + 1) % cfg.metric_freq == 0)):
             if is_valid_contain_train or cfg.is_provide_training_metric:
                 evaluation_result_list.extend(booster.eval_train(feval))
             evaluation_result_list.extend(booster.eval_valid(feval))
@@ -170,6 +173,10 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     boosters = []
     for dtrain, dvalid in fold_data:
         bst = Booster(params=params, train_set=dtrain)
+        if init_model is not None:
+            # continued training per fold (ref: engine.py cv fpreproc-less
+            # path passes init_model through to each fold booster)
+            bst._load_init_model(init_model)
         bst.add_valid(dvalid, "valid")
         boosters.append(bst)
         cvbooster.append(bst)

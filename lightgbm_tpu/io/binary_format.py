@@ -71,6 +71,8 @@ def save_dataset_binary(dataset, filename) -> None:
         "label_idx": int(binned.label_idx),
         "mappers": [_mapper_state(m) for m in binned.mappers],
     }
+    if binned.bundle_info is not None:
+        header["bundles"] = [list(b) for b in binned.bundle_info.bundles]
     arrays = {"bins_fm": binned.bins_fm,
               "header": np.frombuffer(
                   json.dumps(header).encode(), dtype=np.uint8)}
@@ -116,6 +118,11 @@ def load_dataset_binary(filename):
         header["num_total_features"], meta,
         feature_names=header["feature_names"],
         label_idx=header["label_idx"])
+    if "bundles" in header:
+        # rebuild the BundleInfo mapping (storage is already bundled)
+        from ..bundling import BundleInfo
+        binned.bundle_info = BundleInfo.from_bundles(
+            header["bundles"], [m.num_bins for m in mappers])
 
     ds = Dataset.__new__(Dataset)
     ds.data = None

@@ -85,6 +85,7 @@ class _GrowState(NamedTuple):
     pool: jax.Array       # [L, F, B, 3] histogram pool
     leaves: _LeafSplits
     used_features: Optional[jax.Array]  # [L, F] bool (interaction constraints)
+    n_applied: jax.Array  # scalar int32: applied-split counter (leaf ids)
 
 
 def _store_split(leaves: _LeafSplits, idx, info: SplitInfo, depth, output,
@@ -172,10 +173,13 @@ def grow_tree(bins_fm: jax.Array,
               hist_dtype=jnp.float32,
               row_chunk: int = 0,
               hist_impl: str = "xla",
+              hist_precision: str = "highest",
               interaction_groups=None,
               has_categorical: bool = True,
               extra_trees: bool = False,
-              ff_bynode: float = 1.0):
+              ff_bynode: float = 1.0,
+              bundle=None,
+              num_bundle_bins: int = 0):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
 
     sample_mask: [N] float {0,1} bagging/GOSS selection (excluded rows still
@@ -188,12 +192,29 @@ def grow_tree(bins_fm: jax.Array,
     combinations (ref: config.h interaction_constraints).
     """
     num_data = bins_fm.shape[1]
-    num_features = bins_fm.shape[0]
+    num_features = (bins_fm.shape[0] if bundle is None
+                    else bundle[0].shape[0])
     L = num_leaves
     f32 = hist_dtype
 
-    build = functools.partial(hist_ops.build_histogram, max_bins=max_bins,
-                              dtype=f32, row_chunk=row_chunk, impl=hist_impl)
+    if bundle is None:
+        build = functools.partial(
+            hist_ops.build_histogram, max_bins=max_bins, dtype=f32,
+            row_chunk=row_chunk, impl=hist_impl, precision=hist_precision)
+    else:
+        # EFB: build on the bundled [G, N] columns, expand to the logical
+        # per-feature layout (ref: dataset.cpp:251 FastFeatureBundling)
+        from .bundling import expand_bundle_hist
+        group_of, offset_of, nb_arr = bundle
+
+        def build(bins, grad_, hess_, mask_):
+            hg = hist_ops.build_histogram(
+                bins, grad_, hess_, mask_, max_bins=num_bundle_bins,
+                dtype=f32, row_chunk=row_chunk, impl=hist_impl,
+                precision=hist_precision)  # [G, B_tot, 3]
+            totals = jnp.sum(hg[0], axis=0)  # every row hits group 0 once
+            return expand_bundle_hist(hg, group_of, offset_of, nb_arr,
+                                      max_bins, totals)
 
     if interaction_groups is not None:
         interaction_groups = jnp.asarray(interaction_groups, bool)
@@ -245,6 +266,7 @@ def grow_tree(bins_fm: jax.Array,
         leaves=leaves,
         used_features=(jnp.zeros((L, num_features), bool)
                        if interaction_groups is not None else None),
+        n_applied=jnp.int32(0),
     )
 
     if forced is None:
@@ -254,7 +276,6 @@ def grow_tree(bins_fm: jax.Array,
 
     def step(state: _GrowState, step_idx):
         leaves = state.leaves
-        new_leaf = (step_idx + 1).astype(jnp.int32)
 
         # --- forced candidate (ref: serial_tree_learner.cpp:628
         # ForceSplits): stats gathered from the target leaf's histogram;
@@ -301,12 +322,18 @@ def grow_tree(bins_fm: jax.Array,
         rg, rh, rc = pg - lg, ph - lh, pc - lc
 
         valid = use_forced | (leaves.gain[best_leaf] > 0.0)
+        # applied-split counter ids: a forced split can revive growth
+        # after an invalid step, so step_idx+1 would leave id gaps that
+        # Tree.from_arrays/replay can't index. Invalid steps write to the
+        # out-of-bounds dummy L (scatter-dropped under jit).
+        new_leaf = jnp.where(valid, state.n_applied + 1, L).astype(jnp.int32)
+        n_applied = state.n_applied + valid.astype(jnp.int32)
 
         # --- partition rows (left keeps best_leaf id, right -> new_leaf)
         row_leaf = part_ops.apply_split(
             state.row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
             cat_mask, meta.num_bins, meta.missing_type, meta.is_categorical,
-            valid)
+            valid, bundle)
 
         # --- histograms: build smaller child, subtract for the sibling
         # (ref: serial_tree_learner.cpp:373-386,582)
@@ -393,16 +420,23 @@ def grow_tree(bins_fm: jax.Array,
             internal_weight=ph,
             internal_count=pc,
         )
-        return _GrowState(row_leaf, pool, leaves, used_features), record
+        return (_GrowState(row_leaf, pool, leaves, used_features, n_applied),
+                dict(record=record, valid=valid))
 
     # unroll=2: a single-step scan body wrapping pallas_call lowers to a
     # pathologically slow while-loop on TPU (~1000x); any unrolling avoids it
-    state, records = lax.scan(step, state, jnp.arange(L - 1, dtype=jnp.int32),
-                              unroll=2 if L > 2 else 1)
+    state, ys = lax.scan(step, state, jnp.arange(L - 1, dtype=jnp.int32),
+                         unroll=2 if L > 2 else 1)
+    records = ys["record"]
+    # compact valid records first (a forced split can revive growth after
+    # an invalid step; split s must create leaf s+1 gap-free)
+    steps = jnp.arange(L - 1, dtype=jnp.int32)
+    order = jnp.argsort(jnp.where(ys["valid"], steps, steps + L))
+    records = jax.tree_util.tree_map(lambda a: a[order], records)
 
     leaves = state.leaves
     leaf_values = leaves.output
-    num_leaves_out = 1 + jnp.sum(records["split_leaf"] >= 0).astype(jnp.int32)
+    num_leaves_out = 1 + state.n_applied
 
     tree_arrays = TreeArrays(
         split_leaf=records["split_leaf"],
@@ -462,11 +496,15 @@ def grow_tree_waved(bins_fm: jax.Array,
                     max_bins: int,
                     hist_dtype=jnp.float32,
                     hist_impl: str = "xla",
+                    hist_precision: str = "highest",
                     interaction_groups=None,
                     has_categorical: bool = True,
                     wave_max: int = 32,
                     extra_trees: bool = False,
-                    ff_bynode: float = 1.0):
+                    ff_bynode: float = 1.0,
+                    quant: Optional[tuple] = None,
+                    bundle=None,
+                    num_bundle_bins: int = 0):
     """Leaf-wise growth with waved (batched) histogram construction.
 
     Identical split mathematics to `grow_tree`, but histogram builds are
@@ -485,18 +523,60 @@ def grow_tree_waved(bins_fm: jax.Array,
 
     Forced splits are not supported (the caller falls back to
     `grow_tree`).
+
+    quant: optional (g_int [N] int-valued f32, h_int [N] int-valued f32,
+    g_scale, h_scale) from the gradient discretizer. On the pallas path
+    the histogram passes then run the int8 x int8 -> int32 MXU kernel
+    (exact integer accumulation at twice the bf16 rate — the TPU shape of
+    the reference's quantized histograms, gradient_discretizer.hpp:23)
+    and the int32 results are scaled back to the f32 statistics. The
+    `grad`/`hess` arguments must already be the dequantized values
+    (g_int * g_scale) so all non-histogram math is unchanged.
     """
     assert forced is None, "waved growth does not support forced splits"
-    from .ops.pallas_histogram import hist_multi
+    from .ops.pallas_histogram import hist_multi, hist_pallas_multi_int8
 
     num_data = bins_fm.shape[1]
-    num_features = bins_fm.shape[0]
+    num_features = (bins_fm.shape[0] if bundle is None
+                    else bundle[0].shape[0])
     L = num_leaves
     f32 = hist_dtype
     SLOTS = 42  # 128 MXU columns // 3 channels
+    build_bins = max_bins if bundle is None else num_bundle_bins
 
-    build = functools.partial(hist_ops.build_histogram, max_bins=max_bins,
-                              dtype=f32, row_chunk=0, impl=hist_impl)
+    if quant is not None and hist_impl == "pallas":
+        g_int, h_int, g_scale, h_scale = quant
+        m8 = sample_mask.astype(jnp.int8)
+        ghT_i8 = jnp.stack([g_int.astype(jnp.int8) * m8,
+                            h_int.astype(jnp.int8) * m8, m8], axis=1)
+        hscale_vec = jnp.stack([g_scale, h_scale,
+                                jnp.float32(1.0)]).astype(f32)
+
+        def multi_raw(bins, ghT_unused, row_leaf, ids):
+            hist_i = hist_pallas_multi_int8(bins, ghT_i8, row_leaf, ids,
+                                            max_bins=build_bins,
+                                            num_slots=ids.shape[0])
+            return hist_i.astype(f32) * hscale_vec
+    else:
+        def multi_raw(bins, ghT_, row_leaf, ids):
+            # num_slots = the wave's LIVE count: the pallas kernel's cost
+            # is fixed (128 lanes) either way, but the XLA fallback loops
+            # one build per slot, so early 1-8 split waves must not pay
+            # for 42
+            return hist_multi(bins, ghT_, row_leaf, ids,
+                              max_bins=build_bins, num_slots=ids.shape[0],
+                              impl=hist_impl, precision=hist_precision)
+    if bundle is None:
+        multi = multi_raw
+    else:
+        from .bundling import expand_bundle_hist
+        group_of, offset_of, nb_arr = bundle
+
+        def multi(bins, ghT_, row_leaf, ids):
+            hg = multi_raw(bins, ghT_, row_leaf, ids)  # [S, G, B_tot, 3]
+            totals = jnp.sum(hg[:, 0], axis=1)  # [S, 3]
+            return expand_bundle_hist(hg, group_of, offset_of, nb_arr,
+                                      max_bins, totals)
     ghT = jnp.stack([grad * sample_mask, hess * sample_mask, sample_mask],
                     axis=1).astype(jnp.float32)
 
@@ -506,8 +586,13 @@ def grow_tree_waved(bins_fm: jax.Array,
     else:
         root_allowed = None
 
-    # --- root
-    root_hist = build(bins_fm, grad, hess, sample_mask)
+    # --- root: one slot of the multi-leaf kernel (every row is in leaf 0).
+    # The single-leaf kernel's [3, C] x [C, B] dots leave the MXU 97% idle
+    # (M=3 rows); the multi kernel's [f_blk*B, C] x [C, 128] shape is the
+    # efficient one, so the root rides it too.
+    root_ids = jnp.zeros((1,), jnp.int32)
+    root_hist = multi(bins_fm, ghT, jnp.zeros((num_data,), jnp.int32),
+                      root_ids)[0].astype(f32)
     root_g = jnp.sum(grad * sample_mask, dtype=f32)
     root_h = jnp.sum(hess * sample_mask, dtype=f32)
     root_c = jnp.sum(sample_mask, dtype=f32)
@@ -557,11 +642,21 @@ def grow_tree_waved(bins_fm: jax.Array,
         cat_mask=jnp.zeros((max_bins,), jnp.bool_))
 
     def wave_step(carry, step_idx):
-        """Apply one split using STORED candidates only (no histograms)."""
-        row_leaf, leaves, used = carry
-        new_leaf = (step_idx + 1).astype(jnp.int32)
+        """Apply one split using STORED candidates only (no histograms).
+
+        New-leaf ids come from the APPLIED-split counter, not the scan
+        step: a step can be invalid (stale candidates all <= 0) while a
+        later wave revives growth with fresh candidates, and gap-free
+        ids are what Tree.from_arrays and the score updater index by.
+        """
+        row_leaf, leaves, used, n_applied = carry
         best_leaf = jnp.argmax(leaves.gain).astype(jnp.int32)
         valid = leaves.gain[best_leaf] > 0.0
+        # invalid steps use the out-of-bounds id L: every .at[] write to
+        # it is dropped (jit scatter semantics), so a dummy can never
+        # clobber a real leaf's slot
+        new_leaf = jnp.where(valid, n_applied + 1, L).astype(jnp.int32)
+        n_applied = n_applied + valid.astype(jnp.int32)
         feat = leaves.feature[best_leaf]
         thr = leaves.threshold[best_leaf]
         dleft = leaves.default_left[best_leaf]
@@ -569,7 +664,8 @@ def grow_tree_waved(bins_fm: jax.Array,
 
         row_leaf = part_ops.apply_split(
             row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft, cmask,
-            meta.num_bins, meta.missing_type, meta.is_categorical, valid)
+            meta.num_bins, meta.missing_type, meta.is_categorical, valid,
+            bundle)
 
         pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
                       leaves.count[best_leaf])
@@ -618,7 +714,7 @@ def grow_tree_waved(bins_fm: jax.Array,
                   left_id=best_leaf, right_id=new_leaf,
                   small_id=jnp.where(left_smaller, best_leaf, new_leaf),
                   left_smaller=left_smaller)
-        return (row_leaf, leaves, used), ys
+        return (row_leaf, leaves, used, n_applied), ys
 
     def child_candidates(hist, cid, fmask_c, salt, leaves):
         """find_best_split for one child from its stored stats."""
@@ -631,24 +727,30 @@ def grow_tree_waved(bins_fm: jax.Array,
             leaves.depth[cid] - 1, has_categorical, rb)
 
     all_records = []
+    all_valid = []
     s0 = 0
-    for W in _wave_schedule(L, wave_max, SLOTS):
-        (row_leaf, leaves, used_features), ys = lax.scan(
-            wave_step, (row_leaf, leaves, used_features),
+    n_applied = jnp.int32(0)
+    schedule = _wave_schedule(L, wave_max, SLOTS)
+    for wi, W in enumerate(schedule):
+        (row_leaf, leaves, used_features, n_applied), ys = lax.scan(
+            wave_step, (row_leaf, leaves, used_features, n_applied),
             jnp.arange(s0, s0 + W, dtype=jnp.int32))
         all_records.append(ys["record"])
+        all_valid.append(ys["valid"])
         s0 += W
+
+        if wi == len(schedule) - 1:
+            # the tree is full: the children of the final wave can never
+            # be split, so their histograms/candidates are dead weight —
+            # skip the boundary pass entirely (saves 1 of ~13 full-data
+            # passes at 255 leaves)
+            break
 
         # --- wave boundary: ONE multi-leaf pass builds all the wave's
         # smaller children; siblings come from subtraction
         # (ref: serial_tree_learner.cpp:582 histogram subtraction)
         small_ids = jnp.where(ys["valid"], ys["small_id"], -2)
-        pad = SLOTS - W
-        ids_padded = jnp.pad(small_ids, (0, pad), constant_values=-2) \
-            if pad > 0 else small_ids
-        smalls = hist_multi(bins_fm, ghT, row_leaf, ids_padded,
-                            max_bins=max_bins, num_slots=SLOTS,
-                            impl=hist_impl)  # [SLOTS, F, B, 3]
+        smalls = multi(bins_fm, ghT, row_leaf, small_ids)  # [W, F, B, 3]
         for i in range(W):
             valid = ys["valid"][i]
             left_id, right_id = ys["left_id"][i], ys["right_id"][i]
@@ -699,7 +801,16 @@ def grow_tree_waved(bins_fm: jax.Array,
 
     records = jax.tree_util.tree_map(
         lambda *xs: jnp.concatenate(xs, axis=0), *all_records)
-    num_leaves_out = 1 + jnp.sum(records["split_leaf"] >= 0).astype(jnp.int32)
+    # compact: valid splits first, in application order. A stale-candidate
+    # step can be invalid while later waves keep splitting, so raw scan
+    # order may interleave -1 records among real ones; Tree.from_arrays
+    # and replay_tree index split s -> new leaf s+1, which requires the
+    # gap-free prefix this permutation restores.
+    valid_all = jnp.concatenate(all_valid)
+    steps = jnp.arange(L - 1, dtype=jnp.int32)
+    order = jnp.argsort(jnp.where(valid_all, steps, steps + L))
+    records = jax.tree_util.tree_map(lambda a: a[order], records)
+    num_leaves_out = 1 + n_applied
 
     tree_arrays = TreeArrays(
         split_leaf=records["split_leaf"],
@@ -720,7 +831,7 @@ def grow_tree_waved(bins_fm: jax.Array,
 
 
 def replay_tree(tree: TreeArrays, bins_fm: jax.Array,
-                meta: FeatureMeta) -> jax.Array:
+                meta: FeatureMeta, bundle=None) -> jax.Array:
     """Re-derive the row -> leaf map of a grown tree on another binned
     dataset (device). Replays the recorded splits in creation order — the
     device analog of updating a validation ScoreUpdater
@@ -732,7 +843,8 @@ def replay_tree(tree: TreeArrays, bins_fm: jax.Array,
         step_idx, leaf, feat, thr, dleft, cmask = inputs
         row_leaf = part_ops.apply_split(
             row_leaf, bins_fm, leaf, step_idx + 1, feat, thr, dleft, cmask,
-            meta.num_bins, meta.missing_type, meta.is_categorical, leaf >= 0)
+            meta.num_bins, meta.missing_type, meta.is_categorical, leaf >= 0,
+            bundle)
         return row_leaf, None
 
     row_leaf, _ = lax.scan(

@@ -54,8 +54,8 @@ def register_logger(logger: Any, info_method_name: str = "info",
     _warning_method = warning_method_name
 
 
-def _emit(level: int, msg: str) -> None:
-    if level > _level:
+def _emit(level: int, msg: str, force: bool = False) -> None:
+    if level > _level and not force:
         return
     if _logger is not None:
         meth = _warning_method if level <= WARNING else _info_method
@@ -68,8 +68,12 @@ def debug(msg: str) -> None:
     _emit(DEBUG, msg)
 
 
-def info(msg: str) -> None:
-    _emit(INFO, msg)
+def info(msg: str, force: bool = False) -> None:
+    """force=True bypasses the level gate — for output the user
+    explicitly asked for (e.g. an attached log_evaluation callback),
+    matching the reference python package where callback prints route
+    through _log_info regardless of the lib verbosity param."""
+    _emit(INFO, msg, force)
 
 
 def warning(msg: str) -> None:

@@ -60,16 +60,25 @@ class ObjectiveFunction:
     # Higgs scale (10.5M rows) overflows the compile payload entirely
     # (the reference never faces this: its objectives read raw pointers,
     # objective_function.h GetGradients).
-    def device_state(self):
+    # attribute names that EVOLVE across iterations (e.g. lambdarank
+    # position biases). Only these come back out of the fused program —
+    # returning the full state would force XLA to copy every constant
+    # [N] label/weight buffer as a fresh program output each iteration.
+    _evolving_attrs: tuple = ()
+
+    def device_state(self, evolving_only: bool = False):
         """Pytree of this objective's device-resident arrays (recursing
-        into sub-objectives), for passing as explicit jit arguments."""
+        into sub-objectives), for passing as explicit jit arguments.
+        evolving_only=True restricts to `_evolving_attrs` — the subset a
+        fused iteration needs to return as outputs."""
         arrays = {k: v for k, v in vars(self).items()
-                  if isinstance(v, jax.Array)}
+                  if isinstance(v, jax.Array)
+                  and (not evolving_only or k in self._evolving_attrs)}
         sub = {}
         for k, v in vars(self).items():
             if isinstance(v, list) and v and all(
                     isinstance(o, ObjectiveFunction) for o in v):
-                sub[k] = [o.device_state() for o in v]
+                sub[k] = [o.device_state(evolving_only) for o in v]
         return {"arrays": arrays, "sub": sub}
 
     def swap_device_state(self, state):
@@ -351,7 +360,10 @@ class MulticlassSoftmax(ObjectiveFunction):
         onehot = (self.label_int[None, :] ==
                   jnp.arange(k, dtype=jnp.int32)[:, None]).astype(scores.dtype)
         grad = p - onehot
-        hess = 2.0 * p * (1.0 - p)
+        # hessian upper-bound factor K/(K-1)
+        # (ref: multiclass_objective.hpp:32 factor_)
+        factor = k / (k - 1.0) if k > 1 else 2.0
+        hess = factor * p * (1.0 - p)
         if self.weight is not None:
             grad = grad * self.weight[None, :]
             hess = hess * self.weight[None, :]
@@ -456,6 +468,9 @@ class CrossEntropyLambda(ObjectiveFunction):
 # ---------------------------------------------------------------------------
 class _RankingObjective(ObjectiveFunction):
     is_ranking = True
+    # position biases are Newton-updated inside the fused iteration;
+    # everything else (labels, pad layout) is constant
+    _evolving_attrs = ("pos_biases",)
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)

@@ -48,11 +48,11 @@ def default_impl() -> str:
 
 
 @functools.partial(jax.jit, static_argnames=("max_bins", "dtype", "row_chunk",
-                                             "impl"))
+                                             "impl", "precision"))
 def build_histogram(bins_fm: jax.Array, grad: jax.Array, hess: jax.Array,
                     mask: jax.Array, *, max_bins: int,
                     dtype=jnp.float32, row_chunk: int = 0,
-                    impl: str = "xla") -> jax.Array:
+                    impl: str = "xla", precision: str = "highest") -> jax.Array:
     """Build per-feature (grad, hess, count) histograms for one leaf.
 
     Args:
@@ -70,7 +70,8 @@ def build_histogram(bins_fm: jax.Array, grad: jax.Array, hess: jax.Array,
     if impl == "pallas":
         from .pallas_histogram import hist_pallas
         gh3 = jnp.stack([grad * mask, hess * mask, mask]).astype(jnp.float32)
-        return hist_pallas(bins_fm, gh3, max_bins=max_bins).astype(dtype)
+        return hist_pallas(bins_fm, gh3, max_bins=max_bins,
+                           precise=precision).astype(dtype)
 
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1).astype(dtype)  # [N, 3]
     num_features = bins_fm.shape[0]

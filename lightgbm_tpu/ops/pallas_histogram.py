@@ -25,6 +25,19 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+_PRECISIONS = {
+    "default": lax.Precision.DEFAULT,   # 1 bf16 MXU pass, f32 accumulation
+    "high": lax.Precision.HIGH,         # 3 passes
+    "highest": lax.Precision.HIGHEST,   # 6 passes (f32-faithful)
+}
+
+
+def resolve_precision(precise) -> lax.Precision:
+    """bool (legacy) or config string -> lax.Precision."""
+    if isinstance(precise, bool):
+        return lax.Precision.HIGHEST if precise else lax.Precision.DEFAULT
+    return _PRECISIONS[precise]
+
 
 def _hist_kernel(bins_ref, gh_ref, out_ref, *, f_blk: int, max_bins: int,
                  precise: bool):
@@ -36,7 +49,7 @@ def _hist_kernel(bins_ref, gh_ref, out_ref, *, f_blk: int, max_bins: int,
 
     gh = gh_ref[...]  # [3, C] f32
     chunk = gh.shape[1]
-    prec = lax.Precision.HIGHEST if precise else lax.Precision.DEFAULT
+    prec = resolve_precision(precise)
 
     # static unroll: dynamic sublane indexing into a uint8 tile is not
     # supported by Mosaic; keep f_blk * chunk * B * 4 bytes under VMEM
@@ -67,7 +80,7 @@ def _multi_kernel(bins_ref, ghT_ref, rlT_ref, leafsel_ref, out_ref, *,
                      jnp.where(csel == 1, gh[:, 1:2], gh[:, 2:3]))
     # leaf-block-diagonal gh operand: lane k = (leaf k//3, channel k%3)
     bop = jnp.where(rl == leafsel_ref[...], gsel, 0.0)  # [R, 128]
-    prec = lax.Precision.HIGHEST if precise else lax.Precision.DEFAULT
+    prec = resolve_precision(precise)
 
     rows = group * max_bins
     riota = lax.broadcasted_iota(jnp.int32, (rows, r), 0)
@@ -87,7 +100,7 @@ def _multi_kernel(bins_ref, ghT_ref, rlT_ref, leafsel_ref, out_ref, *,
                                     "precise", "interpret"))
 def hist_pallas_multi(bins_fm: jax.Array, ghT: jax.Array, row_leaf: jax.Array,
                       leaf_ids: jax.Array, *, max_bins: int, num_slots: int,
-                      row_chunk: int = 2048, precise: bool = True,
+                      row_chunk: int = 2048, precise="highest",
                       interpret: bool = False) -> jax.Array:
     """Histograms of up to `num_slots` leaves in ONE pass over the rows.
 
@@ -157,6 +170,108 @@ def hist_pallas_multi(bins_fm: jax.Array, ghT: jax.Array, row_leaf: jax.Array,
     return out[:, :num_features]
 
 
+def _multi_kernel_int8(bins_ref, ghT_ref, rlT_ref, leafsel_ref, out_ref, *,
+                       f_blk: int, group: int, max_bins: int):
+    """Integer twin of _multi_kernel: int8 one-hot x int8 leaf-selected
+    quantized (grad, hess, weight) -> int32 accumulation. This is the MXU
+    shape of the reference's quantized histograms (ref:
+    gradient_discretizer.hpp:23 int8 packed gradients, bin.h:351-421
+    ConstructHistogramInt* variants) — exact integer arithmetic at twice
+    the bf16 MXU rate."""
+    ch = pl.program_id(1)
+
+    @pl.when(ch == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    rl = rlT_ref[...]      # [R, 1] int32 row -> leaf
+    gh = ghT_ref[...]      # [R, 3] int8 (g_int, h_int, weight)
+    r = rl.shape[0]
+    lanes = lax.broadcasted_iota(jnp.int32, (r, 128), 1)
+    csel = lanes % 3
+    gsel = jnp.where(csel == 0, gh[:, 0:1],
+                     jnp.where(csel == 1, gh[:, 1:2], gh[:, 2:3]))
+    bop = jnp.where(rl == leafsel_ref[...], gsel,
+                    jnp.int8(0)).astype(jnp.int8)  # [R, 128]
+
+    rows = group * max_bins
+    riota = lax.broadcasted_iota(jnp.int32, (rows, r), 0)
+    for q in range(f_blk // group):
+        b_eff = jnp.zeros((rows, r), jnp.int32)
+        for p in range(group):
+            b_eff = jnp.where(
+                riota // max_bins == p,
+                bins_ref[q * group + p, :][None, :].astype(jnp.int32), b_eff)
+        onehot_t = (b_eff == riota % max_bins).astype(jnp.int8)
+        out_ref[0, q * rows:(q + 1) * rows, :] += jax.lax.dot_general(
+            onehot_t, bop, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("max_bins", "num_slots", "row_chunk",
+                                    "interpret"))
+def hist_pallas_multi_int8(bins_fm: jax.Array, ghT_i8: jax.Array,
+                           row_leaf: jax.Array, leaf_ids: jax.Array, *,
+                           max_bins: int, num_slots: int,
+                           row_chunk: int = 2048,
+                           interpret: bool = False) -> jax.Array:
+    """Quantized multi-leaf histograms: one pass, int32 accumulation.
+
+    ghT_i8: [N, 3] int8 (quantized grad, quantized hess, {0,1} weight),
+    pre-masked. Returns [num_slots, F, B, 3] int32 — callers scale by
+    (g_scale, h_scale, 1) to recover the f32 statistics. Safe for
+    N < 2^31 / (num_grad_quant_bins): |g_int| <= bins/2, so per-bin int32
+    sums cannot overflow at any realistic scale.
+    """
+    num_features, n = bins_fm.shape
+    assert num_slots * 3 <= 128, "num_slots capped at 42 by MXU columns"
+    group = max(1, 128 // max_bins) if max_bins <= 128 else 1
+    f_blk = group * 8 // math.gcd(group, 8)
+    pad_f = (-num_features) % f_blk
+    if pad_f:
+        bins_fm = jnp.pad(bins_fm, ((0, pad_f), (0, 0)), constant_values=0)
+    fp = bins_fm.shape[0]
+    pad_n = (-n) % row_chunk
+    if pad_n:
+        bins_fm = jnp.pad(bins_fm, ((0, 0), (0, pad_n)), constant_values=0)
+        ghT_i8 = jnp.pad(ghT_i8, ((0, pad_n), (0, 0)))
+        row_leaf = jnp.pad(row_leaf, (0, pad_n), constant_values=-1)
+    npad = bins_fm.shape[1]
+
+    k = jnp.arange(128)
+    leafsel = jnp.where(k < 3 * num_slots,
+                        leaf_ids[jnp.minimum(k // 3, num_slots - 1)],
+                        -2).astype(jnp.int32)[None, :]
+
+    fblocks = fp // f_blk
+    rows = f_blk * max_bins
+    grid = (fblocks, npad // row_chunk)
+    out = pl.pallas_call(
+        functools.partial(_multi_kernel_int8, f_blk=f_blk, group=group,
+                          max_bins=max_bins),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((f_blk, row_chunk), lambda j, i: (j, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_chunk, 3), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((row_chunk, 1), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 128), lambda j, i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, rows, 128), lambda j, i: (j, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((fblocks, rows, 128), jnp.int32),
+        interpret=interpret,
+    )(bins_fm, ghT_i8, row_leaf[:, None].astype(jnp.int32), leafsel)
+    out = out[:, :, :3 * num_slots]
+    out = out.reshape(fp, max_bins, num_slots, 3)
+    out = jnp.moveaxis(out, 2, 0)
+    return out[:, :num_features]
+
+
 def hist_multi_xla(bins_fm, ghT, row_leaf, leaf_ids, *, max_bins: int,
                    num_slots: int) -> jax.Array:
     """XLA fallback (CPU tests): loop leaves over build_histogram."""
@@ -172,10 +287,13 @@ def hist_multi_xla(bins_fm, ghT, row_leaf, leaf_ids, *, max_bins: int,
 
 
 def hist_multi(bins_fm, ghT, row_leaf, leaf_ids, *, max_bins: int,
-               num_slots: int, impl: str = "xla") -> jax.Array:
+               num_slots: int, impl: str = "xla",
+               precision: str = "highest") -> jax.Array:
     if impl == "pallas":
         return hist_pallas_multi(bins_fm, ghT, row_leaf, leaf_ids,
-                                 max_bins=max_bins, num_slots=num_slots)
+                                 max_bins=max_bins, num_slots=num_slots,
+                                 precise=precision)
+    # XLA path (CPU tests): f32 dots are exact regardless of precision
     return hist_multi_xla(bins_fm, ghT, row_leaf, leaf_ids,
                           max_bins=max_bins, num_slots=num_slots)
 
@@ -185,7 +303,7 @@ def hist_multi(bins_fm, ghT, row_leaf, leaf_ids, *, max_bins: int,
                                     "precise", "interpret"))
 def hist_pallas(bins_fm: jax.Array, gh3: jax.Array, *, max_bins: int,
                 f_blk: int = 8, row_chunk: int = 0,
-                precise: bool = True, interpret: bool = False) -> jax.Array:
+                precise="highest", interpret: bool = False) -> jax.Array:
     """bins_fm [F, N] uint8/uint16, gh3 [3, N] f32 (pre-masked) ->
     hist [F, B, 3] f32."""
     num_features, n = bins_fm.shape

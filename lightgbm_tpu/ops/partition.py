@@ -15,12 +15,28 @@ import jax.numpy as jnp
 from .split import MISSING_NAN
 
 
+def feature_bins(bins_fm: jax.Array, feature: jax.Array,
+                 bundle=None) -> jax.Array:
+    """Logical [N] bin column of `feature` — a plain row slice for a
+    dense matrix, or an on-the-fly decode of the EFB-bundled matrix
+    (bundle = (group_of, offset_of, num_bins) device arrays; ref:
+    feature_group.h bin_offsets_ decoding)."""
+    if bundle is None:
+        return jnp.take(bins_fm, feature, axis=0).astype(jnp.int32)
+    group_of, offset_of, nb = bundle
+    col = jnp.take(bins_fm, group_of[feature], axis=0).astype(jnp.int32)
+    off = offset_of[feature]
+    in_range = (col >= off) & (col < off + nb[feature] - 1)
+    return jnp.where(in_range, col - off + 1, 0)
+
+
 def apply_split(row_leaf: jax.Array, bins_fm: jax.Array,
                 leaf_id: jax.Array, new_leaf_id: jax.Array,
                 feature: jax.Array, threshold: jax.Array,
                 default_left: jax.Array, cat_mask: jax.Array,
                 num_bins: jax.Array, missing_type: jax.Array,
-                is_categorical: jax.Array, valid: jax.Array) -> jax.Array:
+                is_categorical: jax.Array, valid: jax.Array,
+                bundle=None) -> jax.Array:
     """Send rows of `leaf_id` that fail the decision to `new_leaf_id`.
 
     Numerical: bin <= threshold -> left; the NaN bin (last bin when
@@ -28,7 +44,7 @@ def apply_split(row_leaf: jax.Array, bins_fm: jax.Array,
     `cat_mask` ([B] bool — the device analog of the reference's category
     bitset, tree.h:375) go left. No-op when `valid` is False.
     """
-    fbins = jnp.take(bins_fm, feature, axis=0).astype(jnp.int32)  # [N]
+    fbins = feature_bins(bins_fm, feature, bundle)  # [N]
     nan_bin = num_bins[feature] - 1
     is_nan = (missing_type[feature] == MISSING_NAN) & (fbins == nan_bin)
     numerical = jnp.where(is_nan, default_left, fbins <= threshold)

@@ -1,0 +1,378 @@
+"""Plotting utilities (ref: python-package/lightgbm/plotting.py:37-749).
+
+Same public surface as the reference — ``plot_importance``,
+``plot_split_value_histogram``, ``plot_metric``, ``plot_tree``,
+``create_tree_digraph`` — with one TPU-era upgrade: ``plot_tree`` renders
+natively with matplotlib (recursive tidy layout) instead of requiring the
+graphviz system binary; ``create_tree_digraph`` still produces a graphviz
+object when the library is installed.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+
+from .basic import Booster, LightGBMError
+from .tree import Tree
+
+__all__ = [
+    "plot_importance",
+    "plot_split_value_histogram",
+    "plot_metric",
+    "plot_tree",
+    "create_tree_digraph",
+]
+
+
+def _check_matplotlib():
+    try:
+        import matplotlib.pyplot as plt  # noqa: F401
+        return plt
+    except ImportError as exc:  # pragma: no cover - mpl is present in CI
+        raise ImportError(
+            "matplotlib is required for plotting (install matplotlib)"
+        ) from exc
+
+
+def _booster_trees(booster: Booster) -> List[Tree]:
+    """Flat tree list of a live or loaded booster."""
+    if getattr(booster, "_loaded", None) is not None:
+        return list(booster._loaded.trees)
+    return [t for iter_trees in booster._gbdt.models for t in iter_trees]
+
+
+def _feature_names(booster: Booster) -> List[str]:
+    try:
+        return list(booster.feature_name())
+    except Exception:
+        n = booster.num_feature()
+        return [f"Column_{i}" for i in range(n)]
+
+
+# ----------------------------------------------------------------------
+def plot_importance(booster: Booster, ax=None, height: float = 0.2,
+                    xlim: Optional[Tuple[float, float]] = None,
+                    ylim: Optional[Tuple[float, float]] = None,
+                    title: str = "Feature importance",
+                    xlabel: str = "Feature importance",
+                    ylabel: str = "Features",
+                    importance_type: str = "split",
+                    max_num_features: Optional[int] = None,
+                    ignore_zero: bool = True,
+                    figsize: Optional[Tuple[float, float]] = None,
+                    dpi: Optional[int] = None, grid: bool = True,
+                    precision: Optional[int] = 3, **kwargs):
+    """Horizontal bar chart of feature importances
+    (ref: plotting.py plot_importance)."""
+    plt = _check_matplotlib()
+    importance = np.asarray(
+        booster.feature_importance(importance_type=importance_type),
+        np.float64)
+    names = _feature_names(booster)
+
+    pairs = sorted(zip(importance, names), key=lambda t: t[0])
+    if ignore_zero:
+        pairs = [p for p in pairs if p[0] != 0]
+    if not pairs:
+        raise ValueError("cannot plot importance: all importances are zero")
+    if max_num_features is not None and max_num_features > 0:
+        pairs = pairs[-max_num_features:]
+    values, labels = zip(*pairs)
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ypos = np.arange(len(values))
+    ax.barh(ypos, values, height=height, align="center", **kwargs)
+    for y, v in zip(ypos, values):
+        txt = f"{v:.{precision}f}" if (
+            precision is not None and importance_type == "gain") else f"{v:g}"
+        ax.text(v + 1e-9, y, txt, va="center")
+    ax.set_yticks(ypos)
+    ax.set_yticklabels(labels)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    else:
+        ax.set_ylim(-1, len(values))
+    ax.set_title(title)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+# ----------------------------------------------------------------------
+def plot_split_value_histogram(booster: Booster,
+                               feature: Union[int, str], bins=None,
+                               ax=None, width_coef: float = 0.8,
+                               xlim=None, ylim=None,
+                               title: Optional[str] =
+                               "Split value histogram for feature @feature@",
+                               xlabel: Optional[str] = "Feature split value",
+                               ylabel: Optional[str] = "Count",
+                               figsize=None, dpi=None, grid: bool = True,
+                               **kwargs):
+    """Histogram of the numerical thresholds the model split `feature` at
+    (ref: plotting.py plot_split_value_histogram)."""
+    plt = _check_matplotlib()
+    names = _feature_names(booster)
+    if isinstance(feature, str):
+        try:
+            fidx = names.index(feature)
+        except ValueError:
+            raise ValueError(f"unknown feature name: {feature}")
+        fname = feature
+    else:
+        fidx = int(feature)
+        fname = names[fidx] if fidx < len(names) else f"Column_{fidx}"
+
+    values: List[float] = []
+    for tree in _booster_trees(booster):
+        for i in range(tree.num_internal):
+            if tree.split_feature[i] == fidx and \
+                    (tree.decision_type[i] & 1) == 0:  # numerical only
+                values.append(float(tree.threshold[i]))
+    if not values:
+        raise ValueError(
+            f"cannot plot split value histogram: feature {fname} was not "
+            "used in splitting")
+    values = np.asarray(values)
+    if bins is None:
+        bins = min(len(np.unique(values)) + 1, 50)
+    hist, edges = np.histogram(values, bins=bins)
+    centers = (edges[:-1] + edges[1:]) / 2
+    width = width_coef * (edges[1] - edges[0])
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    ax.bar(centers, hist, width=width, align="center", **kwargs)
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    else:
+        ax.set_ylim(0, max(hist) * 1.1)
+    if title is not None:
+        ax.set_title(title.replace("@feature@", str(fname)))
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel)
+    ax.grid(grid)
+    return ax
+
+
+# ----------------------------------------------------------------------
+def plot_metric(booster_or_record, metric: Optional[str] = None,
+                dataset_names: Optional[List[str]] = None, ax=None,
+                xlim=None, ylim=None,
+                title: Optional[str] = "Metric during training",
+                xlabel: Optional[str] = "Iterations",
+                ylabel: Optional[str] = "@metric@",
+                figsize=None, dpi=None, grid: bool = True):
+    """Plot metric curves recorded by ``callback.record_evaluation``
+    (ref: plotting.py plot_metric; like the reference, a Booster is
+    rejected — pass the eval-result dict)."""
+    plt = _check_matplotlib()
+    if isinstance(booster_or_record, Booster):
+        raise TypeError(
+            "plot_metric takes the dict from record_evaluation(), not a "
+            "Booster (train with callbacks=[record_evaluation(d)])")
+    record: Dict[str, Dict[str, List[float]]] = booster_or_record
+    if not record:
+        raise ValueError("eval results are empty")
+
+    if dataset_names is None:
+        dataset_names = list(record.keys())
+    first = record[dataset_names[0]]
+    if metric is None:
+        if len(first) > 1:
+            raise ValueError(
+                f"more than one metric recorded ({sorted(first)}); pass "
+                "metric= explicitly")
+        metric = next(iter(first))
+
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize, dpi=dpi)
+    for name in dataset_names:
+        if metric not in record.get(name, {}):
+            raise ValueError(f"metric {metric} not recorded for {name}")
+        ys = record[name][metric]
+        ax.plot(range(len(ys)), ys, label=name)
+    ax.legend(loc="best")
+    if xlim is not None:
+        ax.set_xlim(xlim)
+    if ylim is not None:
+        ax.set_ylim(ylim)
+    if title is not None:
+        ax.set_title(title)
+    if xlabel is not None:
+        ax.set_xlabel(xlabel)
+    if ylabel is not None:
+        ax.set_ylabel(ylabel.replace("@metric@", metric))
+    ax.grid(grid)
+    return ax
+
+
+# ----------------------------------------------------------------------
+def _tree_of(booster: Booster, tree_index: int) -> Tree:
+    trees = _booster_trees(booster)
+    if not 0 <= tree_index < len(trees):
+        raise IndexError(
+            f"tree_index {tree_index} out of range (model has "
+            f"{len(trees)} trees)")
+    return trees[tree_index]
+
+
+def _node_label(tree: Tree, node: int, is_leaf: bool, names: List[str],
+                show_info: List[str], precision: int) -> str:
+    if is_leaf:
+        lines = [f"leaf {node}",
+                 f"value: {tree.leaf_value[node]:.{precision}f}"]
+        if "leaf_count" in show_info:
+            lines.append(f"count: {int(tree.leaf_count[node])}")
+        if "leaf_weight" in show_info:
+            lines.append(f"weight: {tree.leaf_weight[node]:.{precision}f}")
+        return "\n".join(lines)
+    f = int(tree.split_feature[node])
+    name = names[f] if f < len(names) else f"Column_{f}"
+    if (tree.decision_type[node] & 1) != 0:
+        cond = f"{name} in bitset"
+    else:
+        cond = f"{name} <= {tree.threshold[node]:.{precision}f}"
+    lines = [cond]
+    if "split_gain" in show_info:
+        lines.append(f"gain: {tree.split_gain[node]:.{precision}f}")
+    if "internal_value" in show_info:
+        lines.append(f"value: {tree.internal_value[node]:.{precision}f}")
+    if "internal_count" in show_info:
+        lines.append(f"count: {int(tree.internal_count[node])}")
+    return "\n".join(lines)
+
+
+def _layout_tree(tree: Tree):
+    """Tidy layout: x = leaf order, y = -depth. Children encodings follow
+    the reference: child >= 0 -> internal node, < 0 -> leaf ~child."""
+    pos: Dict[Tuple[str, int], Tuple[float, float]] = {}
+    next_x = [0.0]
+
+    def walk(node: int, is_leaf: bool, depth: int) -> float:
+        if is_leaf:
+            x = next_x[0]
+            next_x[0] += 1.0
+            pos[("L", node)] = (x, -depth)
+            return x
+        lc, rc = int(tree.left_child[node]), int(tree.right_child[node])
+        xl = walk(~lc if lc < 0 else lc, lc < 0, depth + 1)
+        xr = walk(~rc if rc < 0 else rc, rc < 0, depth + 1)
+        x = (xl + xr) / 2
+        pos[("N", node)] = (x, -depth)
+        return x
+
+    if tree.num_internal > 0:
+        walk(0, False, 0)
+    else:
+        pos[("L", 0)] = (0.0, 0.0)
+    return pos
+
+
+def plot_tree(booster: Booster, tree_index: int = 0, ax=None,
+              figsize=None, dpi=None,
+              show_info: Optional[List[str]] = None,
+              precision: int = 3, orientation: str = "vertical",
+              **kwargs):
+    """Draw one tree with matplotlib (no graphviz binary needed, unlike
+    the reference's plot_tree which shells out to dot)."""
+    plt = _check_matplotlib()
+    tree = _tree_of(booster, tree_index)
+    names = _feature_names(booster)
+    show_info = show_info or []
+
+    pos = _layout_tree(tree)
+    if ax is None:
+        _, ax = plt.subplots(1, 1, figsize=figsize or (12, 8), dpi=dpi)
+
+    def xy(key):
+        x, y = pos[key]
+        return (x, y) if orientation == "vertical" else (-y, -x)
+
+    # edges
+    for node in range(tree.num_internal):
+        for child, tag in ((int(tree.left_child[node]), "yes"),
+                           (int(tree.right_child[node]), "no")):
+            ckey = ("L", ~child) if child < 0 else ("N", child)
+            x0, y0 = xy(("N", node))
+            x1, y1 = xy(ckey)
+            ax.plot([x0, x1], [y0, y1], "-", color="0.6", zorder=1)
+            ax.annotate(tag, ((x0 + x1) / 2, (y0 + y1) / 2), fontsize=7,
+                        color="0.4", ha="center")
+    # nodes
+    for key in pos:
+        kind, node = key
+        x, y = xy(key)
+        label = _node_label(tree, node, kind == "L", names, show_info,
+                            precision)
+        color = "#d5e8d4" if kind == "L" else "#dae8fc"
+        ax.annotate(label, (x, y), ha="center", va="center", fontsize=8,
+                    bbox=dict(boxstyle="round", fc=color, ec="0.4"),
+                    zorder=2, **kwargs)
+    ax.set_axis_off()
+    ax.set_title(f"Tree {tree_index}")
+    return ax
+
+
+def create_tree_digraph(booster: Booster, tree_index: int = 0,
+                        show_info: Optional[List[str]] = None,
+                        precision: int = 3, orientation: str = "horizontal",
+                        name: Optional[str] = None, comment: Optional[str]
+                        = None, filename: Optional[str] = None,
+                        directory: Optional[str] = None,
+                        format: Optional[str] = None, engine: Optional[str]
+                        = None, encoding: Optional[str] = None,
+                        graph_attr: Optional[dict] = None,
+                        node_attr: Optional[dict] = None,
+                        edge_attr: Optional[dict] = None):
+    """graphviz.Digraph of one tree (ref: plotting.py create_tree_digraph);
+    requires the `graphviz` python package."""
+    try:
+        import graphviz
+    except ImportError as exc:
+        raise ImportError(
+            "graphviz is required for create_tree_digraph (plot_tree "
+            "renders without it)") from exc
+    tree = _tree_of(booster, tree_index)
+    names = _feature_names(booster)
+    show_info = show_info or []
+
+    graph = graphviz.Digraph(
+        name=name, comment=comment, filename=filename, directory=directory,
+        format=format, engine=engine, encoding=encoding,
+        graph_attr=dict(graph_attr or {},
+                        rankdir="LR" if orientation == "horizontal" else
+                        "TB"),
+        node_attr=node_attr, edge_attr=edge_attr)
+
+    def nid(kind: str, node: int) -> str:
+        return f"{kind}{node}"
+
+    if tree.num_internal == 0:
+        graph.node(nid("L", 0),
+                   _node_label(tree, 0, True, names, show_info, precision))
+        return graph
+    for node in range(tree.num_internal):
+        graph.node(nid("N", node),
+                   _node_label(tree, node, False, names, show_info,
+                               precision), shape="box")
+    for leaf in range(tree.num_leaves):
+        graph.node(nid("L", leaf),
+                   _node_label(tree, leaf, True, names, show_info,
+                               precision), shape="ellipse")
+    for node in range(tree.num_internal):
+        for child, tag in ((int(tree.left_child[node]), "yes"),
+                           (int(tree.right_child[node]), "no")):
+            target = nid("L", ~child) if child < 0 else nid("N", child)
+            graph.edge(nid("N", node), target, label=tag)
+    return graph

@@ -1,0 +1,170 @@
+"""EFB feature-bundling tests (ref: src/io/dataset.cpp:112 FindGroups,
+:251 FastFeatureBundling; tests/python_package_test coverage of
+enable_bundle)."""
+
+import numpy as np
+import pytest
+
+from conftest import make_binary
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.bundling import (build_bundled_matrix, find_bundles,
+                                   expand_bundle_hist)
+
+
+def _one_hot_data(n=1200, cats=10, dense=2, seed=0):
+    """dense informative features + a strict one-hot block (bundleable)."""
+    r = np.random.RandomState(seed)
+    labels = r.randint(0, cats, n)
+    X = np.zeros((n, dense + cats))
+    X[:, :dense] = r.randn(n, dense)
+    X[np.arange(n), dense + labels] = 1.0
+    logit = X[:, 0] + 2.0 * (labels % 3 == 0) - 1.0
+    y = (logit + 0.3 * r.randn(n) > 0).astype(np.float32)
+    return X, y
+
+
+def test_find_bundles_one_hot():
+    r = np.random.RandomState(0)
+    labels = r.randint(0, 6, 500)
+    masks = np.zeros((6, 500), bool)
+    masks[labels, np.arange(500)] = True
+    nb = np.full(6, 3)
+    bundles = find_bundles(masks, nb, max_conflict_rate=0.0)
+    assert len(bundles) == 1
+    assert sorted(bundles[0]) == list(range(6))
+
+
+def test_find_bundles_conflicting_stay_apart():
+    masks = np.ones((3, 100), bool)  # all features always nonzero
+    nb = np.full(3, 8)
+    bundles = find_bundles(masks, nb, max_conflict_rate=0.0)
+    assert len(bundles) == 3
+
+
+def test_bundled_matrix_roundtrip_decode():
+    """Encode + logical decode must reproduce the original bins."""
+    r = np.random.RandomState(1)
+    f, n = 5, 300
+    nb = np.array([4, 6, 3, 5, 4], np.int64)
+    labels = r.randint(0, f, n)
+    bins = np.zeros((f, n), np.uint8)
+    for i in range(n):  # one nonzero feature per row -> exclusive
+        bins[labels[i], i] = r.randint(1, nb[labels[i]])
+    bundled, info = build_bundled_matrix(bins, nb, [list(range(f))])
+    assert bundled.shape[0] == 1
+    # decode each feature column
+    for feat in range(f):
+        col = bundled[0].astype(np.int64)
+        off = info.offset_of[feat]
+        width = nb[feat] - 1
+        logical = np.where((col >= off) & (col < off + width),
+                           col - off + 1, 0)
+        np.testing.assert_array_equal(logical, bins[feat])
+
+
+def test_expand_bundle_hist_matches_unbundled():
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.histogram import build_histogram
+    r = np.random.RandomState(2)
+    f, n, B = 4, 400, 8
+    nb_arr = np.array([5, 7, 4, 8], np.int64)
+    labels = r.randint(0, f, n)
+    bins = np.zeros((f, n), np.uint8)
+    for i in range(n):
+        bins[labels[i], i] = r.randint(1, nb_arr[labels[i]])
+    grad = r.randn(n).astype(np.float32)
+    hess = np.abs(r.randn(n)).astype(np.float32)
+    mask = (r.rand(n) < 0.9).astype(np.float32)
+
+    ref = build_histogram(jnp.asarray(bins), jnp.asarray(grad),
+                          jnp.asarray(hess), jnp.asarray(mask),
+                          max_bins=B, impl="xla")
+    bundled, info = build_bundled_matrix(bins, nb_arr, [list(range(f))])
+    hg = build_histogram(jnp.asarray(bundled), jnp.asarray(grad),
+                         jnp.asarray(hess), jnp.asarray(mask),
+                         max_bins=info.num_bundle_bins, impl="xla")
+    totals = jnp.sum(hg[0], axis=0)
+    out = expand_bundle_hist(hg, jnp.asarray(info.group_of),
+                             jnp.asarray(info.offset_of),
+                             jnp.asarray(nb_arr.astype(np.int32)), B, totals)
+    # compare only each feature's own valid bins (beyond-range rows hold
+    # neighbors' bins by design and are masked downstream)
+    ref_np, out_np = np.asarray(ref), np.asarray(out)
+    for feat in range(f):
+        valid = int(nb_arr[feat])
+        np.testing.assert_allclose(out_np[feat, :valid], ref_np[feat, :valid],
+                                   rtol=1e-5, atol=1e-5)
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0.5
+    return (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / (
+        pos.sum() * (~pos).sum())
+
+
+def test_bundled_training_matches_unbundled():
+    """Same data trained with and without EFB: storage shrinks, model
+    quality and predictions agree (splits are on the same logical
+    histograms; only the bin-0 row arrives via subtraction)."""
+    X, y = _one_hot_data()
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "min_data_in_bin": 1}
+
+    ds_b = lgb.Dataset(X, label=y, params=dict(params))
+    ds_b.construct()
+    assert ds_b._binned.bundle_info is not None
+    assert ds_b._binned.bins_fm.shape[0] < ds_b._binned.num_features
+
+    ds_u = lgb.Dataset(X, label=y,
+                       params=dict(params, enable_bundle=False))
+    ds_u.construct()
+    assert ds_u._binned.bundle_info is None
+
+    bst_b = lgb.train(dict(params), ds_b, num_boost_round=15)
+    bst_u = lgb.train(dict(params, enable_bundle=False), ds_u,
+                      num_boost_round=15)
+    pb, pu = bst_b.predict(X), bst_u.predict(X)
+    assert _auc(y, pb) > 0.8
+    np.testing.assert_allclose(pb, pu, rtol=2e-2, atol=2e-3)
+
+
+def test_bundled_valid_set_and_exact_grower():
+    """Valid sets bin through the train bundles; the exact (tpu_wave_max=0)
+    grower shares the decode path."""
+    X, y = _one_hot_data(seed=3)
+    Xv, yv = _one_hot_data(seed=4)
+    params = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "min_data_in_leaf": 5, "min_data_in_bin": 1,
+              "tpu_wave_max": 0}
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    dv = lgb.Dataset(Xv, label=yv, reference=ds, params=dict(params))
+    record = {}
+    lgb.train(dict(params), ds, num_boost_round=10, valid_sets=[dv],
+              valid_names=["v"],
+              callbacks=[lgb.record_evaluation(record)])
+    logloss = record["v"]["binary_logloss"]
+    assert logloss[-1] < logloss[0]
+
+
+def test_bundled_binary_roundtrip(tmp_path):
+    X, y = _one_hot_data(seed=5)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1,
+                                         "min_data_in_bin": 1})
+    ds.construct()
+    assert ds._binned.bundle_info is not None
+    path = tmp_path / "b.bin"
+    ds.save_binary(path)
+    loaded = lgb.Dataset(str(path), params={"verbosity": -1})
+    loaded.construct()
+    lb = loaded._binned
+    assert lb.bundle_info is not None
+    np.testing.assert_array_equal(lb.bins_fm, ds._binned.bins_fm)
+    np.testing.assert_array_equal(lb.bundle_info.group_of,
+                                  ds._binned.bundle_info.group_of)
+    bst = lgb.train({"objective": "binary", "verbosity": -1,
+                     "min_data_in_leaf": 5}, loaded, num_boost_round=5)
+    assert bst.num_trees() == 5

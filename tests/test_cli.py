@@ -162,7 +162,10 @@ def test_convert_model_compiles_and_matches(tmp_path, binary_files):
         ctypes.c_int(n), ctypes.c_int(f),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)))
     expected = Booster(model_file=str(model)).predict(Xt, raw_score=True)
-    np.testing.assert_allclose(out, expected, rtol=1e-10)
+    # the C++ codegen accumulates in f64 (reference contract) while the
+    # booster's packed device ensemble accumulates in f32, so agreement
+    # is at f32 resolution, not bitwise
+    np.testing.assert_allclose(out, expected, rtol=1e-6, atol=1e-7)
 
 
 def test_binary_dataset_roundtrip(tmp_path):

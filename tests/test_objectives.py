@@ -127,8 +127,9 @@ def test_multiclass_softmax_gradients():
     onehot = (y[None, :] == np.arange(k)[:, None])
     np.testing.assert_allclose(np.asarray(g), p - onehot, rtol=1e-4,
                                atol=1e-5)
-    np.testing.assert_allclose(np.asarray(h), 2 * p * (1 - p), rtol=1e-4,
-                               atol=1e-5)
+    # hessian factor K/(K-1) (ref: multiclass_objective.hpp:32 factor_)
+    np.testing.assert_allclose(np.asarray(h), (k / (k - 1)) * p * (1 - p),
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_weighted_gradients():

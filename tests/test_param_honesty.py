@@ -63,9 +63,22 @@ def test_dart_weighted_drop_differs_from_uniform():
     assert not np.allclose(b0.predict(X), b1.predict(X))
 
 
-def test_enable_bundle_warns(captured_log):
-    _train({"enable_bundle": True})
-    assert any("enable_bundle" in m for m in captured_log.msgs)
+def test_enable_bundle_bundles_sparse_features():
+    """enable_bundle is real now: mutually-exclusive one-hot columns are
+    stored bundled (fewer stored columns than logical features)."""
+    import lightgbm_tpu as lgb
+    r = np.random.RandomState(0)
+    n = 400
+    labels = r.randint(0, 8, n)
+    X = np.zeros((n, 8))
+    X[np.arange(n), labels] = 1.0  # strict one-hot: zero conflicts
+    y = (labels % 2).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1,
+                                         "min_data_in_bin": 1})
+    ds.construct()
+    binned = ds._binned
+    assert binned.bundle_info is not None
+    assert binned.bins_fm.shape[0] < binned.num_features
 
 
 def test_monotone_method_advanced_warns(captured_log):

@@ -1,0 +1,98 @@
+"""Plotting tests (ref: tests/python_package_test/test_plotting.py)."""
+
+import numpy as np
+import pytest
+
+matplotlib = pytest.importorskip("matplotlib")
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from conftest import make_binary  # noqa: E402
+
+import lightgbm_tpu as lgb  # noqa: E402
+from lightgbm_tpu import plotting  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def booster():
+    X, y = make_binary(500, 6)
+    ds = lgb.Dataset(X, label=y, params={"verbosity": -1})
+    res = {}
+    bst = lgb.train({"objective": "binary", "num_leaves": 8,
+                     "metric": "binary_logloss,auc", "verbosity": -1},
+                    ds, num_boost_round=8, valid_sets=[ds],
+                    valid_names=["train"],
+                    callbacks=[lgb.record_evaluation(res)])
+    bst._eval_record = res
+    return bst
+
+
+def test_plot_importance(booster):
+    ax = plotting.plot_importance(booster)
+    assert ax.get_title() == "Feature importance"
+    assert len(ax.patches) > 0
+    plt.close("all")
+    ax = plotting.plot_importance(booster, importance_type="gain",
+                                  max_num_features=3)
+    assert len(ax.patches) <= 3
+    plt.close("all")
+
+
+def test_plot_split_value_histogram(booster):
+    imp = booster.feature_importance()
+    feat = int(np.argmax(imp))
+    ax = plotting.plot_split_value_histogram(booster, feat)
+    assert len(ax.patches) > 0
+    plt.close("all")
+    with pytest.raises(ValueError):
+        unused = int(np.argmin(imp))
+        if imp[unused] != 0:
+            pytest.skip("all features used")
+        plotting.plot_split_value_histogram(booster, unused)
+    plt.close("all")
+
+
+def test_plot_metric(booster):
+    ax = plotting.plot_metric(booster._eval_record, metric="auc")
+    assert len(ax.lines) == 1
+    plt.close("all")
+    with pytest.raises(ValueError):
+        plotting.plot_metric(booster._eval_record)  # >1 metric, ambiguous
+    with pytest.raises(TypeError):
+        plotting.plot_metric(booster)
+    plt.close("all")
+
+
+def test_plot_tree(booster):
+    ax = plotting.plot_tree(booster, tree_index=0,
+                            show_info=["internal_count", "leaf_count"])
+    assert len(ax.texts) > 0
+    plt.close("all")
+    with pytest.raises(IndexError):
+        plotting.plot_tree(booster, tree_index=999)
+
+
+def test_create_tree_digraph_gated(booster):
+    try:
+        import graphviz  # noqa: F401
+        has_graphviz = True
+    except ImportError:
+        has_graphviz = False
+    if has_graphviz:
+        g = plotting.create_tree_digraph(booster, 0)
+        assert "yes" in g.source
+    else:
+        with pytest.raises(ImportError):
+            plotting.create_tree_digraph(booster, 0)
+
+
+def test_plot_loaded_model(booster, tmp_path):
+    path = tmp_path / "m.txt"
+    booster.save_model(str(path))
+    loaded = lgb.Booster(model_file=str(path))
+    ax = plotting.plot_importance(loaded)
+    assert len(ax.patches) > 0
+    plt.close("all")
+    ax = plotting.plot_tree(loaded, tree_index=1)
+    assert len(ax.texts) > 0
+    plt.close("all")

@@ -152,6 +152,42 @@ def test_hist_pallas_multi_matches_xla():
     assert np.all(np.asarray(pal[4:]) == 0.0)
 
 
+def test_hist_pallas_multi_int8_matches_xla():
+    """The int8 quantized multi-leaf kernel (interpret mode) must agree
+    EXACTLY with the f32 XLA path on integer-valued inputs: both compute
+    sums of small integers, which f32 represents exactly."""
+    from lightgbm_tpu.ops.pallas_histogram import hist_pallas_multi_int8
+    r = np.random.RandomState(2)
+    n, f, b, slots = 600, 5, 16, 42
+    bins = jnp.asarray(r.randint(0, b, (f, n)), jnp.uint8)
+    mask = (r.rand(n) < 0.8).astype(np.int8)
+    g_int = (r.randint(-3, 4, n) * mask).astype(np.int8)
+    h_int = (r.randint(0, 5, n) * mask).astype(np.int8)
+    ghT_i8 = jnp.asarray(np.stack([g_int, h_int, mask], axis=1), jnp.int8)
+    row_leaf = jnp.asarray(r.randint(0, 6, n), jnp.int32)
+    leaf_ids = jnp.asarray([0, 3, 5, 1] + [-2] * (slots - 4), jnp.int32)
+
+    hist_i = hist_pallas_multi_int8(bins, ghT_i8, row_leaf, leaf_ids,
+                                    max_bins=b, num_slots=slots,
+                                    interpret=True)
+    ghT_f = jnp.asarray(np.stack([g_int, h_int, mask], axis=1), jnp.float32)
+    ref = hist_multi_xla(bins, ghT_f, row_leaf, leaf_ids,
+                         max_bins=b, num_slots=slots)
+    assert hist_i.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(hist_i, np.float32),
+                                  np.asarray(ref))
+
+
+def test_waved_quantized_grad_trains():
+    """use_quantized_grad + waved growth end-to-end (CPU falls back to the
+    XLA f32 hist on dequantized values — numerically identical to the
+    int8 device path, which sums the same integers)."""
+    X, y = make_binary(3000)
+    bst = _train(X, y, 32, use_quantized_grad=True,
+                 quant_train_renew_leaf=True)
+    assert _auc(y, bst.predict(X)) > 0.85
+
+
 def test_hist_pallas_single_matches_xla():
     from lightgbm_tpu.ops.histogram import build_histogram
     from lightgbm_tpu.ops.pallas_histogram import hist_pallas

@@ -1,0 +1,141 @@
+"""Generate reference-parity golden metrics from the reference CLI.
+
+Builds (if needed) the reference LightGBM CLI from /root/reference via a
+shadow source tree (the vendored submodules are absent offline, so small
+build shims for fmt / fast_double_parser / Eigen / nanoarrow are injected;
+see tools/ref_shims/ in-tree docs), runs each of the five BASELINE example
+configs (ref: examples/*/train.conf), parses the final-iteration metrics
+from the CLI log, and writes tests/data/reference_golden.json.
+
+The committed JSON is the pinned golden for tests/test_consistency.py —
+re-run this script to regenerate it when the reference changes.
+
+Usage: python tools/gen_reference_golden.py [--binary /path/to/lightgbm]
+"""
+
+import argparse
+import json
+import re
+import subprocess
+import sys
+import tempfile
+from pathlib import Path
+
+REFERENCE = Path("/root/reference")
+REPO = Path(__file__).resolve().parent.parent
+
+CONFIGS = [
+    "binary_classification",
+    "regression",
+    "multiclass_classification",
+    "lambdarank",
+    "xendcg",
+]
+
+# config keys that name input files relative to the example dir
+DATA_KEYS = {"data", "valid_data"}
+
+
+def rewrite_conf(example_dir: Path, out_dir: Path,
+                 overrides: dict | None = None) -> Path:
+    """Copy train.conf with data paths made absolute; model outputs go to
+    the (writable) out_dir. `overrides` force config values (used for the
+    deterministic variants: sampling off so RNG streams don't matter)."""
+    lines = []
+    seen = set()
+    for raw in (example_dir / "train.conf").read_text().splitlines():
+        line = raw.split("#", 1)[0].strip()
+        if not line or "=" not in line:
+            continue
+        key, val = [t.strip() for t in line.split("=", 1)]
+        if key in DATA_KEYS:
+            val = str(example_dir / val)
+        if key == "output_model":
+            val = str(out_dir / val)
+        if overrides and key in overrides:
+            val = str(overrides[key])
+        seen.add(key)
+        lines.append(f"{key} = {val}")
+    for key, val in (overrides or {}).items():
+        if key not in seen:
+            lines.append(f"{key} = {val}")
+    conf = out_dir / "train.conf"
+    conf.write_text("\n".join(lines) + "\n")
+    return conf
+
+
+# deterministic variants: no row/feature sampling, so the only divergence
+# between implementations is binning + split math, not RNG streams
+DETERMINISTIC_OVERRIDES = {
+    "bagging_fraction": 1.0,
+    "bagging_freq": 0,
+    "feature_fraction": 1.0,
+}
+
+
+# CLI log lines look like:
+#   [LightGBM] [Info] Iteration:100, valid_1 auc : 0.812345
+#   [LightGBM] [Info] Iteration:100, training binary_logloss : 0.31
+_METRIC_RE = re.compile(
+    r"Iteration:(\d+), (\S+) (\S+) : ([-+0-9.eEinfan]+)")
+
+
+def run_and_parse(binary: Path, conf: Path, cwd: Path) -> dict:
+    proc = subprocess.run([str(binary), f"config={conf}"], cwd=cwd,
+                          capture_output=True, text=True, timeout=1200)
+    if proc.returncode != 0:
+        sys.stderr.write(proc.stdout + proc.stderr)
+        raise RuntimeError(f"reference CLI failed on {conf}")
+    metrics = {}  # (dataset, metric) -> value at the LAST logged iteration
+    last_iter = {}
+    for line in proc.stdout.splitlines():
+        m = _METRIC_RE.search(line)
+        if not m:
+            continue
+        it, dataset, metric, value = (int(m.group(1)), m.group(2),
+                                      m.group(3), float(m.group(4)))
+        key = f"{dataset}:{metric}"
+        if it >= last_iter.get(key, -1):
+            last_iter[key] = it
+            metrics[key] = value
+    return {"metrics": metrics, "iterations": max(last_iter.values(), default=0)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--binary", default="/tmp/lgbref/lightgbm")
+    ap.add_argument("--out", default=str(REPO / "tests/data/reference_golden.json"))
+    args = ap.parse_args()
+
+    binary = Path(args.binary)
+    if not binary.exists():
+        sys.stderr.write(
+            f"reference binary not found at {binary}; build it first "
+            "(see docstring)\n")
+        return 1
+
+    golden = {"source": "reference CLI run on examples/*/train.conf",
+              "binary": str(binary), "configs": {}}
+    for name in CONFIGS:
+        example_dir = REFERENCE / "examples" / name
+        with tempfile.TemporaryDirectory() as td:
+            out_dir = Path(td)
+            conf = rewrite_conf(example_dir, out_dir)
+            result = run_and_parse(binary, conf, out_dir)
+        golden["configs"][name] = result
+        print(f"{name}: {result['metrics']}")
+        with tempfile.TemporaryDirectory() as td:
+            out_dir = Path(td)
+            conf = rewrite_conf(example_dir, out_dir,
+                                DETERMINISTIC_OVERRIDES)
+            result = run_and_parse(binary, conf, out_dir)
+        golden["configs"][name + "_deterministic"] = result
+        print(f"{name}_deterministic: {result['metrics']}")
+
+    Path(args.out).write_text(json.dumps(golden, indent=2) + "\n")
+    print(f"wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
