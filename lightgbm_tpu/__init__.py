@@ -16,6 +16,7 @@ from .log import register_logger  # noqa: F401
 from . import plotting  # noqa: F401
 from .plotting import (create_tree_digraph, plot_importance,  # noqa: F401
                        plot_metric, plot_split_value_histogram, plot_tree)
+from .io.streaming import DatasetBuilder  # noqa: F401
 
 __version__ = "0.1.0"
 
@@ -25,5 +26,5 @@ __all__ = [
     "early_stopping", "log_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "register_logger",
     "plot_importance", "plot_metric", "plot_split_value_histogram",
-    "plot_tree", "create_tree_digraph", "plotting",
+    "plot_tree", "create_tree_digraph", "plotting", "DatasetBuilder",
 ]

@@ -112,6 +112,21 @@ class Dataset:
                 init_score = sidecar_init_score(path)
             if position is None:
                 position = sidecar_position(path)
+        from .io.arrow_ingest import arrow_to_matrix, arrow_to_vector, is_arrow
+        if is_arrow(data):
+            # Arrow table via the PyCapsule C-ABI protocol — no pyarrow
+            # needed (ref: arrow.h:34, LGBM_DatasetCreateFromArrow)
+            data, arrow_names = arrow_to_matrix(data)
+            if feature_name == "auto" and arrow_names:
+                feature_name = arrow_names
+        if label is not None and is_arrow(label):
+            label = arrow_to_vector(label)
+        if weight is not None and is_arrow(weight):
+            weight = arrow_to_vector(weight)
+        if init_score is not None and is_arrow(init_score):
+            init_score = arrow_to_vector(init_score)
+        if group is not None and is_arrow(group):
+            group = arrow_to_vector(group)
         self.data = _to_2d(data)
         self.label = label
         self.weight = weight
@@ -497,16 +512,16 @@ class Booster:
             n = self._loaded.max_feature_idx + 1
             out = np.zeros(n, np.float64)
             trees = self._loaded.trees
-            if iteration > 0:
+            if iteration >= 0:
                 trees = trees[:iteration *
                               max(self._loaded.num_tree_per_iteration, 1)]
             for tree in trees:
                 for i in range(tree.num_internal):
                     f = int(tree.split_feature[i])
-                    if importance_type == "gain":
-                        out[f] += max(float(tree.split_gain[i]), 0.0)
-                    else:
+                    if importance_type == "split":
                         out[f] += 1.0
+                    else:
+                        out[f] += max(float(tree.split_gain[i]), 0.0)
             return out
         return self._gbdt.feature_importance(importance_type, iteration)
 
@@ -528,21 +543,28 @@ class Booster:
 
     def set_network(self, machines, local_listen_port=12400,
                     listen_time_out=120, num_machines=1) -> "Booster":
-        """Record a machine list for multi-host training. Socket-based
-        collectives are replaced by XLA collectives over the device mesh
-        (parallel/mesh.py); multi-process runs must initialize
-        jax.distributed instead (parallel.distributed.init_distributed)
-        — a machine list alone cannot join processes, so setting one
-        here warns rather than silently doing nothing."""
+        """Join the machine list's distributed runtime. The reference's
+        TCP collectives become jax.distributed + XLA collectives: the
+        first machine is the coordinator and this process's rank comes
+        from the LGBM_TPU_RANK env var (each reference worker likewise
+        locates itself in mlist.txt)."""
         from . import log
-        log.warning(
-            "set_network: TCP collectives are not used on TPU; for "
-            "multi-host training initialize jax.distributed "
-            "(lightgbm_tpu.parallel.distributed.init_distributed) — "
-            "the machine list is recorded for API compatibility only")
+        from .parallel import distributed as dist
         self._network_params = dict(machines=machines,
                                     local_listen_port=local_listen_port,
                                     num_machines=num_machines)
+        if num_machines and int(num_machines) > 1:
+            import os
+            if os.environ.get("LGBM_TPU_RANK") is None:
+                log.warning(
+                    "set_network: machine list given but LGBM_TPU_RANK is "
+                    "unset — cannot determine this process's rank, so the "
+                    "distributed runtime was NOT initialized; set "
+                    "LGBM_TPU_RANK or call parallel.distributed."
+                    "init_distributed(process_id=...) directly")
+            else:
+                dist.init_distributed(machines=machines,
+                                      num_processes=int(num_machines))
         return self
 
     def shuffle_models(self, start_iteration=0, end_iteration=-1) -> "Booster":

@@ -627,6 +627,11 @@ class GBDT:
         return jnp.asarray(mask)
 
     # ------------------------------------------------------------------
+    def _sync_init_scores(self, scores: np.ndarray) -> np.ndarray:
+        """Hook: distributed learners average per-machine init scores
+        (ref: gbdt.cpp:322 Network::GlobalSyncUpByMean)."""
+        return scores
+
     def _boost_from_average(self):
         """(ref: gbdt.cpp:328)"""
         if self._init_done:
@@ -635,16 +640,20 @@ class GBDT:
         if (self.objective is None or self._has_init_score or
                 not self.config.boost_from_average):
             return
+        raw = self._sync_init_scores(np.asarray(
+            [self.objective.boost_from_score(k)
+             for k in range(self.num_tree_per_iteration)], np.float64))
         for k in range(self.num_tree_per_iteration):
-            s = self.objective.boost_from_score(k)
-            if abs(s) > K_EPSILON:
-                self.init_scores[k] = s
+            if abs(raw[k]) > K_EPSILON:
+                self.init_scores[k] = float(raw[k])
         if any(abs(s) > K_EPSILON for s in self.init_scores):
             init = jnp.asarray(np.asarray(self.init_scores, np.float32)
                                [:, None])
-            self.scores = self.scores + init
+            add = jax.jit(lambda s, i: s + i)  # jit: works on globally
+            # sharded multi-host arrays too (eager ops would not)
+            self.scores = add(self.scores, init)
             for vi in range(len(self._valid_scores)):
-                self._valid_scores[vi] = self._valid_scores[vi] + init
+                self._valid_scores[vi] = add(self._valid_scores[vi], init)
 
     def _gradients(self, custom_grad=None, custom_hess=None):
         """-> grad, hess [K, N] (ref: GBDT::Boosting gbdt.cpp:229)."""
@@ -952,6 +961,8 @@ class GBDT:
         trees = [t for it in self.models[start_iteration:end] for t in it]
         if not trees:
             return np.zeros((data.shape[0], self.num_tree_per_iteration))
+        if self.config.pred_early_stop:
+            return self._predict_raw_early_stop(data, start_iteration, end)
         if any(t.is_linear for t in trees):
             return self._predict_raw_host(data, start_iteration, end)
         from .ops.predict import predict_raw_cached
@@ -967,6 +978,37 @@ class GBDT:
         for it in range(start_iteration, end):
             for ki, tree in enumerate(self.models[it]):
                 out[:, ki] += tree.predict(data)
+        return out
+
+    def _predict_raw_early_stop(self, data: np.ndarray, start_iteration: int,
+                                end: int) -> np.ndarray:
+        """Row-wise prediction with early termination (ref:
+        prediction_early_stop.cpp CreatePredictionEarlyStopInstance:
+        binary stops when |margin| > margin_threshold, multiclass when
+        top1 - top2 > threshold, checked every `freq` trees). A host
+        path by design: data-dependent per-row loop exits fit the CPU;
+        the device ensemble path evaluates all trees faster than it
+        could branch."""
+        n = data.shape[0]
+        k = self.num_tree_per_iteration
+        freq = max(int(self.config.pred_early_stop_freq), 1)
+        margin = float(self.config.pred_early_stop_margin)
+        out = np.zeros((n, k))
+        active = np.ones(n, bool)
+        for idx, it in enumerate(range(start_iteration, end)):
+            rows = np.flatnonzero(active)
+            if rows.size == 0:
+                break
+            sub = data[rows]
+            for ki, tree in enumerate(self.models[it]):
+                out[rows, ki] += tree.predict(sub)
+            if (idx + 1) % freq == 0:
+                if k == 1:
+                    stop = np.abs(out[rows, 0]) > margin
+                else:
+                    part = np.partition(out[rows], k - 2, axis=1)
+                    stop = (part[:, -1] - part[:, -2]) > margin
+                active[rows[stop]] = False
         return out
 
     def predict(self, data: np.ndarray, raw_score: bool = False,

@@ -1,0 +1,208 @@
+"""Arrow C-data-interface ingestion, dependency-free.
+
+TPU-native counterpart of the reference's nanoarrow-based ingestion
+(ref: include/LightGBM/arrow.h:34 ArrowChunkedArray,
+src/arrow/array.hpp, c_api.cpp LGBM_DatasetCreateFromArrow). pyarrow is
+not required: any object implementing the Arrow PyCapsule protocol
+(``__arrow_c_array__`` / ``__arrow_c_stream__`` — pyarrow Tables,
+polars DataFrames, nanoarrow wrappers...) is consumed directly through
+the C ABI structs via ctypes.
+
+Supported layouts: a struct array (table) of primitive numeric /
+boolean children, or a primitive array for labels/weights. Validity
+bitmaps map nulls to NaN, matching the reference's null_default
+(src/arrow/array.hpp null_default -> quiet_NaN).
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+PyCapsule_GetPointer = ctypes.pythonapi.PyCapsule_GetPointer
+PyCapsule_GetPointer.restype = ctypes.c_void_p
+PyCapsule_GetPointer.argtypes = [ctypes.py_object, ctypes.c_char_p]
+
+
+class ArrowSchema(ctypes.Structure):
+    pass
+
+
+ArrowSchema._fields_ = [
+    ("format", ctypes.c_char_p),
+    ("name", ctypes.c_char_p),
+    ("metadata", ctypes.c_char_p),
+    ("flags", ctypes.c_int64),
+    ("n_children", ctypes.c_int64),
+    ("children", ctypes.POINTER(ctypes.POINTER(ArrowSchema))),
+    ("dictionary", ctypes.POINTER(ArrowSchema)),
+    ("release", ctypes.c_void_p),
+    ("private_data", ctypes.c_void_p),
+]
+
+
+class ArrowArray(ctypes.Structure):
+    pass
+
+
+ArrowArray._fields_ = [
+    ("length", ctypes.c_int64),
+    ("null_count", ctypes.c_int64),
+    ("offset", ctypes.c_int64),
+    ("n_buffers", ctypes.c_int64),
+    ("n_children", ctypes.c_int64),
+    ("buffers", ctypes.POINTER(ctypes.c_void_p)),
+    ("children", ctypes.POINTER(ctypes.POINTER(ArrowArray))),
+    ("dictionary", ctypes.POINTER(ArrowArray)),
+    ("release", ctypes.c_void_p),
+    ("private_data", ctypes.c_void_p),
+]
+
+
+# Arrow format chars -> numpy dtype (primitive subset the reference's
+# visitor supports, src/arrow/array.hpp visit())
+_FORMAT_DTYPES = {
+    b"c": np.int8, b"C": np.uint8,
+    b"s": np.int16, b"S": np.uint16,
+    b"i": np.int32, b"I": np.uint32,
+    b"l": np.int64, b"L": np.uint64,
+    b"f": np.float32, b"g": np.float64,
+}
+
+
+def _bitmap_to_bool(ptr: int, offset: int, length: int) -> np.ndarray:
+    nbytes = (offset + length + 7) // 8
+    raw = np.ctypeslib.as_array(
+        ctypes.cast(ptr, ctypes.POINTER(ctypes.c_uint8)), (nbytes,))
+    bits = np.unpackbits(raw, bitorder="little")
+    return bits[offset:offset + length].astype(bool)
+
+
+def _primitive_to_numpy(schema: ArrowSchema, arr: ArrowArray,
+                        parent_offset: int = 0,
+                        parent_length: Optional[int] = None) -> np.ndarray:
+    """Read a primitive child. Per the Arrow C data interface, a struct
+    parent's offset/length apply logically to its children (sliced
+    tables export offset on the parent while children keep full
+    buffers), so element i of the parent reads child[i + parent_offset].
+    """
+    fmt = schema.format
+    off = int(arr.offset) + int(parent_offset)
+    length = (int(parent_length) if parent_length is not None
+              else int(arr.length) - int(parent_offset))
+    if fmt == b"b":  # boolean: bit-packed values buffer
+        values = _bitmap_to_bool(arr.buffers[1], off, length).astype(
+            np.float64)
+    else:
+        dtype = _FORMAT_DTYPES.get(fmt)
+        if dtype is None:
+            raise ValueError(
+                f"unsupported Arrow type format {fmt!r} (primitive "
+                "numeric/boolean only, like the reference's arrow.h)")
+        n_items = off + length
+        buf = np.ctypeslib.as_array(
+            ctypes.cast(arr.buffers[1],
+                        ctypes.POINTER(np.ctypeslib.as_ctypes_type(dtype))),
+            (n_items,))
+        values = buf[off:off + length].astype(np.float64)
+    if arr.null_count != 0 and arr.buffers[0]:
+        valid = _bitmap_to_bool(arr.buffers[0], off, length)
+        values = np.where(valid, values, np.nan)
+    return values
+
+
+def _capsule_to_structs(obj) -> Tuple[ArrowSchema, ArrowArray]:
+    schema_cap, array_cap = obj.__arrow_c_array__()
+    schema_ptr = PyCapsule_GetPointer(schema_cap, b"arrow_schema")
+    array_ptr = PyCapsule_GetPointer(array_cap, b"arrow_array")
+    schema = ctypes.cast(schema_ptr, ctypes.POINTER(ArrowSchema)).contents
+    array = ctypes.cast(array_ptr, ctypes.POINTER(ArrowArray)).contents
+    # keep the capsules alive until we've copied out of the buffers
+    return schema, array, (schema_cap, array_cap)
+
+
+def arrow_to_matrix(obj) -> Tuple[np.ndarray, Optional[List[str]]]:
+    """An Arrow struct array/table -> dense [N, F] float64 + column names.
+    One-copy (column extraction), like the reference's row-iterator
+    ingestion which also materializes into Dataset storage."""
+    chunks: List[Tuple] = []
+    if hasattr(obj, "__arrow_c_stream__"):
+        chunks = list(_iter_stream(obj))
+    elif hasattr(obj, "__arrow_c_array__"):
+        chunks = [_capsule_to_structs(obj)]
+    else:
+        raise TypeError(
+            "object does not speak the Arrow PyCapsule protocol "
+            "(__arrow_c_array__/__arrow_c_stream__)")
+
+    mats = []
+    names: Optional[List[str]] = None
+    for schema, array, keepalive in chunks:
+        if schema.format != b"+s":
+            raise ValueError("expected a struct array (table) for "
+                             "feature data")
+        f = int(schema.n_children)
+        cols = []
+        names = []
+        for j in range(f):
+            cschema = schema.children[j].contents
+            carr = array.children[j].contents
+            cols.append(_primitive_to_numpy(
+                cschema, carr, parent_offset=int(array.offset),
+                parent_length=int(array.length)))
+            names.append((cschema.name or b"").decode() or f"Column_{j}")
+        mats.append(np.column_stack(cols) if cols else
+                    np.zeros((int(array.length), 0)))
+        del keepalive
+    return (np.concatenate(mats, axis=0) if len(mats) > 1 else mats[0],
+            names)
+
+
+def arrow_to_vector(obj) -> np.ndarray:
+    """A primitive Arrow array -> [N] float64 (labels/weights/init
+    scores; ref: c_api.cpp LGBM_DatasetSetFieldFromArrow)."""
+    if hasattr(obj, "__arrow_c_array__"):
+        schema, array, keepalive = _capsule_to_structs(obj)
+        if schema.format == b"+s":
+            raise ValueError("expected a primitive array, got a struct")
+        out = _primitive_to_numpy(schema, array)
+        del keepalive
+        return out
+    raise TypeError("object does not speak the Arrow PyCapsule protocol")
+
+
+def _iter_stream(obj):
+    """Drain an __arrow_c_stream__ exporter chunk by chunk."""
+    cap = obj.__arrow_c_stream__()
+    ptr = PyCapsule_GetPointer(cap, b"arrow_array_stream")
+
+    class ArrowArrayStream(ctypes.Structure):
+        _fields_ = [
+            ("get_schema", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ArrowSchema))),
+            ("get_next", ctypes.CFUNCTYPE(
+                ctypes.c_int, ctypes.c_void_p, ctypes.POINTER(ArrowArray))),
+            ("get_last_error", ctypes.CFUNCTYPE(
+                ctypes.c_char_p, ctypes.c_void_p)),
+            ("release", ctypes.c_void_p),
+            ("private_data", ctypes.c_void_p),
+        ]
+
+    stream = ctypes.cast(ptr, ctypes.POINTER(ArrowArrayStream)).contents
+    schema = ArrowSchema()
+    if stream.get_schema(ptr, ctypes.byref(schema)) != 0:
+        raise RuntimeError("Arrow stream: get_schema failed")
+    while True:
+        array = ArrowArray()
+        if stream.get_next(ptr, ctypes.byref(array)) != 0:
+            raise RuntimeError("Arrow stream: get_next failed")
+        if not array.release:
+            break
+        yield schema, array, (cap,)
+
+
+def is_arrow(obj) -> bool:
+    return (hasattr(obj, "__arrow_c_array__")
+            or hasattr(obj, "__arrow_c_stream__"))

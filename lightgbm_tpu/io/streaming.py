@@ -1,0 +1,113 @@
+"""Streaming dataset construction (chunked row pushes).
+
+TPU-native analog of the reference's ChunkedArray + streaming C API
+(ref: include/LightGBM/utils/chunked_array.hpp, c_api.cpp:1330
+LGBM_DatasetPushRows*, tests/cpp_tests/test_stream.cpp:253). Producers
+push row blocks (with per-block label/weight/init-score/group slices)
+as they arrive; `finalize()` coalesces once and bins — the same
+copy-on-finalize contract ChunkedArray gives the reference's
+distributed ingestion (Spark/SynapseML streaming)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+class DatasetBuilder:
+    """Accumulate row chunks, then produce a constructed Dataset.
+
+    Example:
+        b = DatasetBuilder(num_features=28, params={"max_bin": 63})
+        for X_chunk, y_chunk in producer:
+            b.push_rows(X_chunk, label=y_chunk)
+        ds = b.finalize()
+    """
+
+    def __init__(self, num_features: int,
+                 params: Optional[Dict[str, Any]] = None,
+                 reference=None):
+        self.num_features = int(num_features)
+        self.params = dict(params or {})
+        self.reference = reference
+        self._chunks: List[np.ndarray] = []
+        self._labels: List[np.ndarray] = []
+        self._weights: List[np.ndarray] = []
+        self._init_scores: List[np.ndarray] = []
+        self._groups: List[np.ndarray] = []
+        self._finalized = False
+
+    @property
+    def num_pushed(self) -> int:
+        return sum(c.shape[0] for c in self._chunks)
+
+    def push_rows(self, data, label=None, weight=None, init_score=None,
+                  group=None) -> "DatasetBuilder":
+        """Append a [n, F] block (ref: LGBM_DatasetPushRows c_api.cpp).
+        Metadata slices are per-block and optional, but each field must
+        be provided either for every block or for none."""
+        if self._finalized:
+            raise RuntimeError("builder already finalized")
+        block = np.atleast_2d(np.asarray(data, np.float64))
+        if block.shape[1] != self.num_features:
+            raise ValueError(
+                f"pushed block has {block.shape[1]} features, expected "
+                f"{self.num_features}")
+        # validate everything BEFORE mutating, so a rejected push leaves
+        # the builder unchanged
+        fields = []
+        for value, store, name in (
+                (label, self._labels, "label"),
+                (weight, self._weights, "weight"),
+                (init_score, self._init_scores, "init_score"),
+                (group, self._groups, "group")):
+            if value is not None:
+                if self._chunks and not store:
+                    raise ValueError(
+                        f"{name} was missing for earlier blocks but "
+                        "provided for this one (all-or-none per field)")
+                arr = np.asarray(value)
+                if name != "group" and arr.shape[0] != block.shape[0]:
+                    raise ValueError(
+                        f"{name} slice has {arr.shape[0]} rows, block has "
+                        f"{block.shape[0]}")
+                fields.append((store, arr))
+            elif store:
+                raise ValueError(
+                    f"{name} was provided for earlier blocks but missing "
+                    "for this one")
+        self._chunks.append(block)
+        for store, arr in fields:
+            store.append(arr)
+        return self
+
+    def finalize(self):
+        """Coalesce chunks and construct the Dataset (one copy — the
+        ChunkedArray coalesce contract)."""
+        from ..basic import Dataset
+        if self._finalized:
+            raise RuntimeError("builder already finalized")
+        if not self._chunks:
+            raise ValueError("no rows pushed")
+        self._finalized = True
+        X = (self._chunks[0] if len(self._chunks) == 1
+             else np.concatenate(self._chunks, axis=0))
+
+        def _cat(parts):
+            if not parts:
+                return None
+            return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+        ds = Dataset(X, label=_cat(self._labels),
+                     weight=_cat(self._weights),
+                     init_score=_cat(self._init_scores),
+                     group=_cat(self._groups),
+                     reference=self.reference,
+                     params=self.params)
+        self._chunks.clear()
+        self._labels.clear()
+        self._weights.clear()
+        self._init_scores.clear()
+        self._groups.clear()
+        return ds.construct()

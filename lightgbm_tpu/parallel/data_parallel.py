@@ -39,6 +39,9 @@ class _DataParallelMixin:
 
     def _setup_sharding(self, num_shards: int):
         self.mesh = mesh_lib.get_mesh(num_shards)
+        if jax.process_count() > 1:
+            self._setup_multihost()
+            return
         # bins [F, N]: rows sharded, features replicated
         self.bins_fm = mesh_lib.shard_data(self.mesh, self.bins_fm, row_axis=1)
         # scores [K, N]: rows sharded
@@ -52,6 +55,66 @@ class _DataParallelMixin:
             # one-hot path partitions its contraction over the sharded row
             # axis (shard_map + pallas planned)
             self._build_grow("xla")
+
+    def _setup_multihost(self):
+        """Assemble globally-sharded state from this process's row shard
+        (ref: distributed loading at dataset_loader.cpp:211 — every
+        machine holds its own rows; plus GBDT's init-score mean sync at
+        gbdt.cpp:322). Requires jax.distributed to be initialized
+        (parallel.distributed.init_distributed) and every process to
+        hold an equal-size shard divisible by its local device count."""
+        from . import distributed as dist
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mesh = self.mesh
+        n_local = int(self.train_set.num_data)
+        n_dev_local = len(jax.local_devices())
+        if n_local % n_dev_local != 0:
+            raise ValueError(
+                f"multi-host shard of {n_local} rows is not divisible by "
+                f"the {n_dev_local} local devices; pad or repartition "
+                "the input (the reference pre-partitions too, "
+                "tests/distributed/_test_distributed.py)")
+
+        host_bins = np.asarray(self.train_set.bins_fm)
+        self.bins_fm = dist.make_global_array(mesh, host_bins, row_axis=1)
+        self.num_data = self.bins_fm.shape[1]
+        # preserve whatever the base init put into the local scores
+        # (dataset init_score offsets) — still process-local here
+        self.scores = dist.make_global_array(
+            mesh, np.asarray(self.scores, np.float32), row_axis=1)
+        self._sample_mask = dist.make_global_array(
+            mesh, np.asarray(self._sample_mask, np.float32), row_axis=0)
+        self.feature_meta = jax.tree_util.tree_map(
+            lambda a: jax.device_put(np.asarray(a),
+                                     NamedSharding(mesh, P())),
+            self.feature_meta)
+        # objective device buffers: [N_local]-leading arrays become row
+        # shards of the global array; everything else is replicated
+        if self.objective is not None:
+            for name, arr in list(vars(self.objective).items()):
+                if not isinstance(arr, jax.Array):
+                    continue
+                if arr.ndim >= 1 and arr.shape[0] == n_local:
+                    garr = dist.make_global_array(mesh, np.asarray(arr),
+                                                  row_axis=0)
+                elif arr.ndim >= 2 and arr.shape[1] == n_local:
+                    garr = dist.make_global_array(mesh, np.asarray(arr),
+                                                  row_axis=1)
+                else:
+                    garr = jax.device_put(np.asarray(arr),
+                                          NamedSharding(mesh, P()))
+                setattr(self.objective, name, garr)
+        self._build_grow("xla")
+
+    def _sync_init_scores(self, scores: np.ndarray) -> np.ndarray:
+        # per-machine init scores averaged across processes
+        # (ref: gbdt.cpp:322 Network::GlobalSyncUpByMean)
+        if jax.process_count() <= 1:
+            return scores
+        from jax.experimental import multihost_utils
+        allv = np.asarray(multihost_utils.process_allgather(
+            scores.astype(np.float32)))  # [P, K]
+        return allv.mean(axis=0).astype(np.float64)
 
     @property
     def num_machines(self) -> int:

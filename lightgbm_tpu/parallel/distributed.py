@@ -1,0 +1,194 @@
+"""Multi-host (multi-process) training seam.
+
+TPU-native replacement for the reference's socket/MPI transport layer
+(ref: src/network/linkers.h:38 Linkers, linkers_socket.cpp machine-list
+handshake). Instead of a TCP mesh with hand-rolled Bruck/halving
+collectives, processes join one JAX distributed runtime
+(`jax.distributed.initialize`): every chip in every process lands in one
+global device list, a `Mesh` spans them, and XLA lowers the same
+`psum`/`psum_scatter`/`all_gather` the single-host path uses — over
+ICI within a slice and DCN across slices.
+
+The reference's machine-list convention is kept as the user-facing
+config surface (`machines="ip:port,ip:port"`, `num_machines`,
+`local_listen_port`): the first machine is the coordinator, and each
+process identifies itself by `process_id` (or the LGBM_TPU_RANK env
+var), mirroring how each reference worker finds itself in mlist.txt.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from .. import log
+
+_initialized = False
+
+
+def parse_machine_list(machines) -> List[str]:
+    """Accept the reference's formats: comma list "ip:port,ip:port", or
+    lines "ip port" (mlist.txt, ref: examples/parallel_learning)."""
+    if isinstance(machines, (list, tuple)):
+        entries = [str(m) for m in machines]
+    else:
+        text = str(machines)
+        if "\n" in text or (os.path.sep in text and os.path.exists(text)):
+            if os.path.exists(text):
+                text = open(text).read()
+            entries = [ln.strip() for ln in text.splitlines() if ln.strip()]
+        else:
+            entries = [tok.strip() for tok in text.split(",") if tok.strip()]
+    out = []
+    for e in entries:
+        out.append(e.replace(" ", ":") if ":" not in e else e)
+    return out
+
+
+def init_distributed(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     machines=None,
+                     local_device_ids=None) -> None:
+    """Join this process into the global JAX runtime.
+
+    Either pass `coordinator_address`/`num_processes`/`process_id`
+    directly, or a reference-style `machines` list (first entry is the
+    coordinator; `process_id` falls back to the LGBM_TPU_RANK env var).
+    Idempotent per process.
+    """
+    global _initialized
+    if _initialized:
+        return
+    import jax
+
+    if machines is not None:
+        mlist = parse_machine_list(machines)
+        if not mlist:
+            raise ValueError("empty machine list")
+        coordinator_address = coordinator_address or mlist[0]
+        num_processes = num_processes or len(mlist)
+    if process_id is None:
+        env_rank = os.environ.get("LGBM_TPU_RANK")
+        if env_rank is None:
+            raise ValueError(
+                "process_id is required (or set LGBM_TPU_RANK): each "
+                "worker must know its rank, like each reference worker "
+                "finds itself in mlist.txt")
+        process_id = int(env_rank)
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids)
+    _initialized = True
+    log.info(f"distributed runtime up: process {process_id}/"
+             f"{num_processes}, {len(jax.devices())} global devices "
+             f"({len(jax.local_devices())} local)")
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def process_count() -> int:
+    import jax
+    return jax.process_count()
+
+
+def process_index() -> int:
+    import jax
+    return jax.process_index()
+
+
+# ----------------------------------------------------------------------
+# host-metadata sync (the analog of the reference's rank-0 bin-mapper
+# sync during distributed loading, dataset_loader.cpp:211)
+
+
+def _broadcast_bytes(payload: Optional[bytes]) -> bytes:
+    """Broadcast a byte string from process 0 to all (two-phase:
+    length, then padded data)."""
+    import jax
+    from jax.experimental import multihost_utils
+
+    root = jax.process_index() == 0
+    length = np.array([len(payload) if root and payload is not None else 0],
+                      np.int64)
+    length = np.asarray(
+        multihost_utils.broadcast_one_to_all(length))
+    n = int(length[0])
+    buf = np.zeros(n, np.uint8)
+    if root and payload is not None:
+        buf[:] = np.frombuffer(payload, np.uint8)
+    buf = np.asarray(multihost_utils.broadcast_one_to_all(buf))
+    return buf.tobytes()
+
+
+def sync_bin_mappers(mappers):
+    """Rank 0's bin mappers win; everyone else re-bins its local shard
+    with them (ref: dataset_loader.cpp:211 — rank 0 samples, finds
+    boundaries and syncs them so all machines agree on bin ids)."""
+    import jax
+    from ..io.binary_format import _mapper_from_state, _mapper_state
+
+    if jax.process_count() <= 1:
+        return mappers
+    payload = None
+    if jax.process_index() == 0:
+        payload = json.dumps([_mapper_state(m) for m in mappers]).encode()
+    data = _broadcast_bytes(payload)
+    states = json.loads(data.decode())
+    return [_mapper_from_state(s) for s in states]
+
+
+def sync_dataset(dataset) -> None:
+    """Align a constructed basic.Dataset's binning with rank 0
+    (ref: dataset_loader.cpp:211 — rank 0's bin boundaries win and every
+    machine re-extracts its local rows with them). In-place."""
+    import jax
+    if jax.process_count() <= 1:
+        return
+    binned = dataset._binned
+    if binned.bundle_info is not None:
+        raise ValueError("EFB bundling is not supported with multi-host "
+                         "training yet; set enable_bundle=false")
+    from ..io.binary_format import _mapper_from_state, _mapper_state
+    payload = None
+    if jax.process_index() == 0:
+        payload = json.dumps({
+            "mappers": [_mapper_state(m) for m in binned.mappers],
+            "used_features": [int(c) for c in binned.used_features],
+        }).encode()
+    blob = json.loads(_broadcast_bytes(payload).decode())
+    if jax.process_index() != 0:
+        from ..dataset import _transform_all
+        raw = binned.raw_data
+        if raw is None:
+            raise ValueError(
+                "multi-host bin sync needs raw feature values on every "
+                "process (in-memory datasets only for now)")
+        binned.mappers = [_mapper_from_state(s) for s in blob["mappers"]]
+        binned.used_features = list(blob["used_features"])
+        binned.bins_fm = _transform_all(
+            np.asarray(raw), binned.mappers, binned.used_features,
+            binned.bins_fm.dtype)
+        binned._device_cache.clear()
+
+
+def make_global_array(mesh, local_rows: np.ndarray, row_axis: int):
+    """Assemble a globally-sharded array from per-process row shards
+    (the multi-host version of mesh.shard_data)."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from .mesh import DATA_AXIS
+
+    spec = [None] * local_rows.ndim
+    spec[row_axis] = DATA_AXIS
+    sharding = NamedSharding(mesh, P(*spec))
+    if jax.process_count() <= 1:
+        return jax.device_put(local_rows, sharding)
+    return jax.make_array_from_process_local_data(sharding, local_rows)

@@ -1,0 +1,194 @@
+"""Arrow C-ABI ingestion + streaming push tests (ref: test_arrow.py,
+test_stream.cpp:253 — here with hand-built C-ABI structs since pyarrow
+is not in the image; the PyCapsule protocol is exercised for real)."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from conftest import make_binary
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.arrow_ingest import (ArrowArray, ArrowSchema,
+                                          arrow_to_matrix, arrow_to_vector)
+from lightgbm_tpu.io.streaming import DatasetBuilder
+
+PyCapsule_New = ctypes.pythonapi.PyCapsule_New
+PyCapsule_New.restype = ctypes.py_object
+PyCapsule_New.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_void_p]
+
+
+class _FakeArrowTable:
+    """Minimal __arrow_c_array__ exporter: a struct array whose children
+    are float64/int32 numpy columns (zero-copy buffers kept alive on
+    self)."""
+
+    def __init__(self, columns, names, validity=None):
+        self._keep = []
+        self._names = [n.encode() for n in names]
+        n = len(columns[0])
+
+        child_schemas = []
+        child_arrays = []
+        fmt_for = {np.dtype(np.float64): b"g", np.dtype(np.float32): b"f",
+                   np.dtype(np.int32): b"i", np.dtype(np.int64): b"l"}
+        for j, col in enumerate(columns):
+            col = np.ascontiguousarray(col)
+            self._keep.append(col)
+            cs = ArrowSchema()
+            cs.format = fmt_for[col.dtype]
+            cs.name = self._names[j]
+            cs.metadata = None
+            cs.flags = 0
+            cs.n_children = 0
+            cs.children = None
+            cs.dictionary = None
+            cs.release = None
+            child_schemas.append(cs)
+
+            ca = ArrowArray()
+            ca.length = n
+            ca.offset = 0
+            ca.n_children = 0
+            ca.children = None
+            ca.dictionary = None
+            ca.release = 1  # non-null: "owned elsewhere"
+            bufs = (ctypes.c_void_p * 2)()
+            vmask = None if validity is None else validity[j]
+            if vmask is None:
+                ca.null_count = 0
+                bufs[0] = None
+            else:
+                ca.null_count = int((~vmask).sum())
+                packed = np.packbits(vmask.astype(np.uint8),
+                                     bitorder="little")
+                self._keep.append(packed)
+                bufs[0] = packed.ctypes.data
+            bufs[1] = col.ctypes.data
+            self._keep.append(bufs)
+            ca.n_buffers = 2
+            ca.buffers = bufs
+            child_arrays.append(ca)
+
+        self._child_schemas = child_schemas
+        self._child_arrays = child_arrays
+        cs_ptrs = (ctypes.POINTER(ArrowSchema) * len(columns))(
+            *[ctypes.pointer(s) for s in child_schemas])
+        ca_ptrs = (ctypes.POINTER(ArrowArray) * len(columns))(
+            *[ctypes.pointer(a) for a in child_arrays])
+        self._keep += [cs_ptrs, ca_ptrs]
+
+        self._schema = ArrowSchema()
+        self._schema.format = b"+s"
+        self._schema.name = b""
+        self._schema.metadata = None
+        self._schema.flags = 0
+        self._schema.n_children = len(columns)
+        self._schema.children = cs_ptrs
+        self._schema.dictionary = None
+        self._schema.release = None
+
+        self._array = ArrowArray()
+        self._array.length = n
+        self._array.null_count = 0
+        self._array.offset = 0
+        self._array.n_buffers = 1
+        bufs0 = (ctypes.c_void_p * 1)()
+        bufs0[0] = None
+        self._keep.append(bufs0)
+        self._array.buffers = bufs0
+        self._array.n_children = len(columns)
+        self._array.children = ca_ptrs
+        self._array.dictionary = None
+        self._array.release = 1
+
+    def __arrow_c_array__(self, requested_schema=None):
+        return (PyCapsule_New(ctypes.byref(self._schema), b"arrow_schema",
+                              None),
+                PyCapsule_New(ctypes.byref(self._array), b"arrow_array",
+                              None))
+
+
+class _FakeArrowVector(_FakeArrowTable):
+    def __init__(self, values):
+        super().__init__([np.ascontiguousarray(values)], ["v"])
+
+    def __arrow_c_array__(self, requested_schema=None):
+        return (PyCapsule_New(ctypes.byref(self._child_schemas[0]),
+                              b"arrow_schema", None),
+                PyCapsule_New(ctypes.byref(self._child_arrays[0]),
+                              b"arrow_array", None))
+
+
+def test_arrow_table_to_matrix():
+    cols = [np.arange(5, dtype=np.float64),
+            np.array([1, 2, 3, 4, 5], np.int32)]
+    table = _FakeArrowTable(cols, ["a", "b"])
+    mat, names = arrow_to_matrix(table)
+    assert names == ["a", "b"]
+    np.testing.assert_array_equal(mat[:, 0], cols[0])
+    np.testing.assert_array_equal(mat[:, 1], cols[1].astype(np.float64))
+
+
+def test_arrow_nulls_become_nan():
+    col = np.array([1.0, 2.0, 3.0, 4.0])
+    valid = np.array([True, False, True, True])
+    table = _FakeArrowTable([col], ["x"], validity=[valid])
+    mat, _ = arrow_to_matrix(table)
+    assert np.isnan(mat[1, 0])
+    assert mat[0, 0] == 1.0 and mat[2, 0] == 3.0
+
+
+def test_arrow_dataset_trains():
+    X, y = make_binary(400, 4)
+    table = _FakeArrowTable([np.ascontiguousarray(X[:, j]) for j in range(4)],
+                            [f"f{j}" for j in range(4)])
+    label = _FakeArrowVector(y.astype(np.float64))
+    ds = lgb.Dataset(table, label=label, params={"verbosity": -1})
+    ds.construct()
+    assert ds._binned.feature_names[:2] == ["f0", "f1"]
+    bst = lgb.train({"objective": "binary", "verbosity": -1}, ds,
+                    num_boost_round=5)
+    assert bst.num_trees() == 5
+
+
+def test_arrow_vector():
+    v = arrow_to_vector(_FakeArrowVector(np.array([3.0, 1.0, 2.0])))
+    np.testing.assert_array_equal(v, [3.0, 1.0, 2.0])
+
+
+# ----------------------------------------------------------------------
+def test_streaming_builder_matches_monolithic():
+    X, y = make_binary(600, 5)
+    w = np.abs(np.random.RandomState(0).randn(600)) + 0.5
+
+    b = DatasetBuilder(num_features=5, params={"verbosity": -1})
+    for s in range(0, 600, 150):
+        b.push_rows(X[s:s + 150], label=y[s:s + 150], weight=w[s:s + 150])
+    assert b.num_pushed == 600
+    ds_stream = b.finalize()
+
+    ds_mono = lgb.Dataset(X, label=y, weight=w, params={"verbosity": -1})
+    ds_mono.construct()
+    np.testing.assert_array_equal(ds_stream._binned.bins_fm,
+                                  ds_mono._binned.bins_fm)
+
+    params = {"objective": "binary", "verbosity": -1, "num_leaves": 15}
+    p1 = lgb.train(dict(params), ds_stream, num_boost_round=5).predict(X)
+    p2 = lgb.train(dict(params), ds_mono, num_boost_round=5).predict(X)
+    np.testing.assert_allclose(p1, p2, rtol=1e-6)
+
+
+def test_streaming_builder_validation():
+    b = DatasetBuilder(num_features=3)
+    b.push_rows(np.zeros((4, 3)), label=np.zeros(4))
+    with pytest.raises(ValueError):
+        b.push_rows(np.zeros((4, 2)), label=np.zeros(4))  # wrong F
+    with pytest.raises(ValueError):
+        b.push_rows(np.zeros((4, 3)))  # label missing after being given
+    b.push_rows(np.zeros((2, 3)), label=np.ones(2))
+    ds = b.finalize()
+    assert ds._binned.num_data == 6
+    with pytest.raises(RuntimeError):
+        b.finalize()

@@ -174,3 +174,49 @@ class TestMaxDeltaStep:
         for it in bst._gbdt.models:
             for t in it:
                 assert np.abs(t.leaf_value).max() <= 0.5 + 1e-5
+
+
+class TestPredictionEarlyStop:
+    def test_binary_early_stop_close_to_full(self):
+        """pred_early_stop trades exactness for speed: rows whose margin
+        is already decisive stop traversing (ref:
+        prediction_early_stop.cpp). With a small margin, hard rows keep
+        the same sign; with a huge margin, results are identical."""
+        from conftest import make_binary
+        import lightgbm_tpu as lgb
+        X, y = make_binary(800, 6)
+        params = {"objective": "binary", "num_leaves": 15, "verbosity": -1}
+        ds = lgb.Dataset(X, label=y, params=dict(params))
+        bst = lgb.train(dict(params), ds, num_boost_round=30)
+        full = bst.predict(X, raw_score=True)
+
+        bst._gbdt.config.pred_early_stop = True
+        bst._gbdt.config.pred_early_stop_freq = 5
+        bst._gbdt.config.pred_early_stop_margin = 1e9
+        exact = bst.predict(X, raw_score=True)
+        # early-stop path sums trees in f64 on host; the default path is
+        # the f32 device ensemble — agreement at f32 resolution
+        np.testing.assert_allclose(exact, full, rtol=1e-4, atol=1e-6)
+
+        bst._gbdt.config.pred_early_stop_margin = 0.5
+        approx = bst.predict(X, raw_score=True)
+        # decisions agree even where magnitudes were truncated
+        assert np.mean((approx > 0) == (full > 0)) > 0.98
+        # margin-exceeding rows really did stop early
+        assert np.any(np.abs(approx) < np.abs(full) - 1e-12)
+        bst._gbdt.config.pred_early_stop = False
+
+    def test_multiclass_early_stop(self):
+        from conftest import make_multiclass
+        import lightgbm_tpu as lgb
+        X, y = make_multiclass(900, 6, 3)
+        params = {"objective": "multiclass", "num_class": 3,
+                  "num_leaves": 7, "verbosity": -1}
+        ds = lgb.Dataset(X, label=y, params=dict(params))
+        bst = lgb.train(dict(params), ds, num_boost_round=12)
+        full = np.argmax(bst.predict(X), axis=1)
+        bst._gbdt.config.pred_early_stop = True
+        bst._gbdt.config.pred_early_stop_freq = 3
+        bst._gbdt.config.pred_early_stop_margin = 0.5
+        approx = np.argmax(bst.predict(X), axis=1)
+        assert np.mean(approx == full) > 0.97

@@ -1,0 +1,111 @@
+"""Multi-host (two-process) training test — the DistributedMockup
+pattern (ref: tests/distributed/_test_distributed.py:53: N worker
+subprocesses on localhost, pre-partitioned rows, tree_learner=data,
+central-vs-distributed agreement asserted)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+WORKER = r"""
+import json, os, sys
+import numpy as np
+rank = int(sys.argv[1])
+port = sys.argv[2]
+tmp = sys.argv[3]
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {repo!r})
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel import distributed as dist
+
+dist.init_distributed(coordinator_address=f"127.0.0.1:{{port}}",
+                      num_processes=2, process_id=rank)
+
+data = np.load(f"{{tmp}}/shard{{rank}}.npz")
+X, y = data["X"], data["y"]
+params = {{"objective": "binary", "tree_learner": "data", "num_leaves": 15,
+           "min_data_in_leaf": 5, "verbosity": -1, "max_bin": 63,
+           "enable_bundle": False}}
+ds = lgb.Dataset(X, label=y, params=dict(params))
+ds.construct()
+dist.sync_dataset(ds)
+bst = lgb.Booster(params, ds)
+for _ in range(8):
+    bst.update()
+if rank == 0:
+    bst.save_model(f"{{tmp}}/dist_model.txt")
+print(f"worker {{rank}} done: {{bst.num_trees()}} trees", flush=True)
+"""
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_data_parallel(tmp_path):
+    rng = np.random.RandomState(0)
+    n, f = 800, 6  # 400 rows per process, divisible by 2 local devices
+    X = rng.randn(n, f)
+    logit = X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.3 * X[:, 2] * X[:, 3]
+    y = (logit + 0.2 * rng.randn(n) > 0.5).astype(np.float32)
+
+    np.savez(tmp_path / "shard0.npz", X=X[: n // 2], y=y[: n // 2])
+    np.savez(tmp_path / "shard1.npz", X=X[n // 2:], y=y[n // 2:])
+
+    port = _free_port()
+    worker_py = tmp_path / "worker.py"
+    worker_py.write_text(WORKER.format(repo=str(REPO)))
+    procs = [subprocess.Popen(
+        [sys.executable, str(worker_py), str(r), str(port), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        for r in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=840)
+        outs.append(out)
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {r} failed:\n{out[-4000:]}"
+
+    # central model on the full data for comparison
+    import lightgbm_tpu as lgb
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1, "max_bin": 63}
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    central = lgb.train(dict(params), ds, num_boost_round=8)
+
+    dist_model = lgb.Booster(model_file=str(tmp_path / "dist_model.txt"))
+    assert dist_model.num_trees() == 8
+    p_c = central.predict(X)
+    p_d = dist_model.predict(X)
+    # distributed mappers come from rank 0's half, so bin boundaries (and
+    # with them individual splits) differ slightly from the central run;
+    # the MODELS must still agree (ref asserts the same,
+    # _test_distributed.py:168,184)
+    agree = np.mean((p_c > 0.5) == (p_d > 0.5))
+    assert agree > 0.9, f"central-vs-distributed agreement {agree}"
+    auc_d = _auc(y, p_d)
+    assert auc_d > 0.85, f"distributed AUC {auc_d}"
+
+
+def _auc(y, s):
+    order = np.argsort(s)
+    ranks = np.empty(len(s))
+    ranks[order] = np.arange(1, len(s) + 1)
+    pos = y > 0.5
+    return (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / (
+        pos.sum() * (~pos).sum())
