@@ -38,6 +38,11 @@ def main():
     logit = (x[:, 0] + 0.6 * x[:, 1] ** 2 + 0.4 * x[:, 2] * x[:, 3]
              - 0.3 * np.abs(x[:, 4]) + 0.5 * rng.randn(n))
     y = (logit > 0.2).astype(np.float32)
+    n_test = 200_000
+    xt = rng.randn(n_test, f).astype(np.float32)
+    lt = (xt[:, 0] + 0.6 * xt[:, 1] ** 2 + 0.4 * xt[:, 2] * xt[:, 3]
+          - 0.3 * np.abs(xt[:, 4]) + 0.5 * rng.randn(n_test))
+    yt = (lt > 0.2).astype(np.float32)
 
     params = {
         "objective": "binary",
@@ -63,7 +68,9 @@ def main():
     t0 = time.time()
     for _ in range(iters):
         bst.update()
-    jax.block_until_ready(bst._gbdt.scores)
+    # block via a host transfer: block_until_ready alone has proven
+    # unreliable on the tunneled axon platform
+    _ = np.asarray(bst._gbdt.scores[0, :8])
     dt = (time.time() - t0) / iters
 
     iters_per_sec = 1.0 / dt
@@ -75,8 +82,21 @@ def main():
         "vs_baseline": round(iters_per_sec / baseline, 4),
     }
     print(json.dumps(result))
+    # quality sanity: held-out AUC after the benchmarked iterations — a
+    # guard on the bf16-input histogram path (tpu_hist_precision default)
+    try:
+        pred = bst.predict(xt, raw_score=True)
+        order = np.argsort(pred)
+        ranks = np.empty(n_test)
+        ranks[order] = np.arange(1, n_test + 1)
+        pos = yt > 0.5
+        auc = (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / (
+            pos.sum() * (~pos).sum())
+        auc_line = f"test_auc@{warmup + iters}iters={auc:.4f}"
+    except Exception as exc:  # never let the sanity check kill the bench
+        auc_line = f"auc_check_failed={exc!r}"
     print(f"# bin={bin_time:.1f}s warmup+compile={warm_time:.1f}s "
-          f"per_iter={dt:.3f}s", file=sys.stderr)
+          f"per_iter={dt:.3f}s {auc_line}", file=sys.stderr)
 
 
 if __name__ == "__main__":

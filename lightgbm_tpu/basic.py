@@ -512,7 +512,9 @@ class Booster:
             n = self._loaded.max_feature_idx + 1
             out = np.zeros(n, np.float64)
             trees = self._loaded.trees
-            if iteration >= 0:
+            # iteration <= 0 means all trees (ref: gbdt_model_text.cpp
+            # FeatureImportance 'if (num_iteration > 0)')
+            if iteration > 0:
                 trees = trees[:iteration *
                               max(self._loaded.num_tree_per_iteration, 1)]
             for tree in trees:
@@ -553,6 +555,10 @@ class Booster:
         self._network_params = dict(machines=machines,
                                     local_listen_port=local_listen_port,
                                     num_machines=num_machines)
+        if (not num_machines or int(num_machines) <= 1) and machines:
+            # reference configs often leave num_machines at 1 and rely
+            # on the machine list length
+            num_machines = len(dist.parse_machine_list(machines))
         if num_machines and int(num_machines) > 1:
             import os
             if os.environ.get("LGBM_TPU_RANK") is None:

@@ -961,7 +961,11 @@ class GBDT:
         trees = [t for it in self.models[start_iteration:end] for t in it]
         if not trees:
             return np.zeros((data.shape[0], self.num_tree_per_iteration))
-        if self.config.pred_early_stop:
+        # classification only — regression/ranking need accurate sums
+        # (ref: predictor.hpp:47 gates on !NeedAccuratePrediction)
+        if self.config.pred_early_stop and self.config.objective in (
+                "binary", "multiclass", "multiclassova", "cross_entropy",
+                "cross_entropy_lambda"):
             return self._predict_raw_early_stop(data, start_iteration, end)
         if any(t.is_linear for t in trees):
             return self._predict_raw_host(data, start_iteration, end)
@@ -1004,7 +1008,9 @@ class GBDT:
                 out[rows, ki] += tree.predict(sub)
             if (idx + 1) % freq == 0:
                 if k == 1:
-                    stop = np.abs(out[rows, 0]) > margin
+                    # ref: prediction_early_stop.cpp CreateBinary uses
+                    # margin = 2 * |pred|
+                    stop = 2.0 * np.abs(out[rows, 0]) > margin
                 else:
                     part = np.partition(out[rows], k - 2, axis=1)
                     stop = (part[:, -1] - part[:, -2]) > margin
@@ -1048,8 +1054,9 @@ class GBDT:
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split",
                            iteration: int = -1) -> np.ndarray:
-        """(ref: GBDT::FeatureImportance gbdt.cpp)"""
-        end = len(self.models) if iteration < 0 else min(
+        """(ref: GBDT::FeatureImportance gbdt.cpp — num_iteration <= 0
+        means all trees)"""
+        end = len(self.models) if iteration <= 0 else min(
             len(self.models), iteration)
         imp = np.zeros(self.train_set.num_total_features)
         for it in range(end):

@@ -194,13 +194,24 @@ def _iter_stream(obj):
     schema = ArrowSchema()
     if stream.get_schema(ptr, ctypes.byref(schema)) != 0:
         raise RuntimeError("Arrow stream: get_schema failed")
-    while True:
-        array = ArrowArray()
-        if stream.get_next(ptr, ctypes.byref(array)) != 0:
-            raise RuntimeError("Arrow stream: get_next failed")
-        if not array.release:
-            break
-        yield schema, array, (cap,)
+    release_t = ctypes.CFUNCTYPE(None, ctypes.c_void_p)
+    try:
+        while True:
+            array = ArrowArray()
+            if stream.get_next(ptr, ctypes.byref(array)) != 0:
+                raise RuntimeError("Arrow stream: get_next failed")
+            if not array.release:
+                break
+            try:
+                yield schema, array, (cap,)
+            finally:
+                # consumer owns each chunk: release after copying out
+                # (Arrow C stream ownership contract)
+                if array.release:
+                    release_t(array.release)(ctypes.byref(array))
+    finally:
+        if schema.release:
+            release_t(schema.release)(ctypes.byref(schema))
 
 
 def is_arrow(obj) -> bool:

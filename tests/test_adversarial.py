@@ -1,0 +1,140 @@
+"""Adversarial semantics tests (VERDICT r2 #9): grid monotonicity on
+deep trees with conflicting interactions, and wide-categorical bitset
+round-trips (ref: monotone_constraints.hpp, tree.h:375 categorical
+bitset decisions)."""
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+
+
+def _train(X, y, params, rounds=30):
+    ds = lgb.Dataset(X, label=y, params=dict(params))
+    return lgb.train(dict(params), ds, num_boost_round=rounds)
+
+
+def test_monotone_grid_deep_tree_conflicting_interactions():
+    """y depends on x0 through a sign-flipping interaction (x0*x1): an
+    unconstrained model is non-monotone in x0; with monotone +1 on x0
+    every prediction slice along x0 must be nondecreasing, at every
+    depth of a deep tree (this catches constraint-propagation bugs that
+    shallow smooth checks miss)."""
+    rng = np.random.RandomState(0)
+    n = 4000
+    X = rng.uniform(-2, 2, (n, 4))
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2]
+         + 0.2 * rng.randn(n)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 63,
+              "min_data_in_leaf": 5, "learning_rate": 0.2,
+              "verbosity": -1,
+              "monotone_constraints": [1, 0, 0, 0]}
+    bst = _train(X, y, params)
+
+    # sanity: the unconstrained model IS non-monotone on this target
+    un = _train(X, y, {**params, "monotone_constraints": [0, 0, 0, 0]})
+    sweep = np.linspace(-2, 2, 41)
+    base = rng.uniform(-2, 2, (60, 4))
+    violated_unconstrained = False
+    max_violation = 0.0
+    for row in base:
+        grid = np.tile(row, (len(sweep), 1))
+        grid[:, 0] = sweep
+        p = bst.predict(grid)
+        diffs = np.diff(p)
+        max_violation = max(max_violation, float(-(diffs.min()))
+                            if diffs.size else 0.0)
+        pu = un.predict(grid)
+        if np.any(np.diff(pu) < -1e-6):
+            violated_unconstrained = True
+    assert violated_unconstrained, (
+        "fixture too easy: unconstrained model is already monotone")
+    assert max_violation <= 1e-6, (
+        f"monotone violation {max_violation} on constrained model")
+
+
+def test_monotone_decreasing_with_bagging_and_depth_cap():
+    rng = np.random.RandomState(1)
+    n = 3000
+    X = rng.uniform(-1, 1, (n, 3))
+    y = (-X[:, 0] * np.abs(X[:, 1]) + 0.3 * X[:, 2]
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    params = {"objective": "regression", "num_leaves": 31, "max_depth": 6,
+              "bagging_fraction": 0.7, "bagging_freq": 1,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "monotone_constraints": [-1, 0, 0]}
+    bst = _train(X, y, params, rounds=20)
+    sweep = np.linspace(-1, 1, 31)
+    for row in rng.uniform(-1, 1, (40, 3)):
+        grid = np.tile(row, (len(sweep), 1))
+        grid[:, 0] = sweep
+        assert np.all(np.diff(bst.predict(grid)) <= 1e-6)
+
+
+def test_wide_categorical_bitset_roundtrip():
+    """>64 categories forces multi-word bitsets. The chain
+    train -> device predict -> text serialize -> reload -> host predict
+    must agree exactly on category routing."""
+    rng = np.random.RandomState(2)
+    n, cats = 5000, 80
+    c = rng.randint(0, cats, n)
+    x1 = rng.randn(n)
+    group_effect = (c % 7 == 0) * 2.0 - (c % 11 == 3) * 1.5
+    y = (group_effect + 0.5 * x1 + 0.2 * rng.randn(n)).astype(np.float32)
+    X = np.column_stack([c.astype(np.float64), x1])
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 5, "min_data_per_group": 1,
+              "max_cat_threshold": 64, "cat_smooth": 1.0,
+              "verbosity": -1, "categorical_feature": [0]}
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0],
+                     params=dict(params))
+    bst = lgb.train(dict(params), ds, num_boost_round=20)
+
+    used_cat_split = any(
+        (t.num_cat or 0) > 0
+        for it in bst._gbdt.models for t in it)
+    assert used_cat_split, "fixture never split on the categorical"
+    # multi-word bitsets actually exercised (80 cats > 32-bit word)
+    assert any(
+        len(t.cat_threshold) > (t.cat_boundaries[1] - t.cat_boundaries[0]
+                                if t.num_cat else 0) or
+        any(np.diff(t.cat_boundaries) > 1)
+        for it in bst._gbdt.models for t in it if t.num_cat)
+
+    direct = bst.predict(X)
+    text = bst.model_to_string()
+    from lightgbm_tpu.model_io import load_model_from_string
+    loaded = load_model_from_string(text)
+    via_text = np.asarray(loaded.predict_raw(X)).reshape(-1)
+    np.testing.assert_allclose(direct, via_text, rtol=1e-5, atol=1e-6)
+
+    # unseen categories route by the default (missing) direction and
+    # must not crash (ref: CategoricalDecision out-of-range -> default)
+    X_unseen = X.copy()
+    X_unseen[:10, 0] = cats + 500
+    p_unseen = bst.predict(X_unseen)
+    assert np.all(np.isfinite(p_unseen))
+
+
+def test_categorical_monotone_combination():
+    """Monotone constraint on a numerical feature while a categorical
+    feature drives interactions — the constraint must hold regardless
+    of category routing."""
+    rng = np.random.RandomState(3)
+    n, cats = 4000, 12
+    c = rng.randint(0, cats, n)
+    x1 = rng.uniform(-1, 1, n)
+    slope = np.where(c % 2 == 0, 2.0, -1.0)  # conflicting slopes by cat
+    y = (slope * x1 + 0.1 * rng.randn(n)).astype(np.float32)
+    X = np.column_stack([c.astype(np.float64), x1])
+    params = {"objective": "regression", "num_leaves": 31,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "categorical_feature": [0],
+              "monotone_constraints": [0, 1]}
+    ds = lgb.Dataset(X, label=y, categorical_feature=[0],
+                     params=dict(params))
+    bst = lgb.train(dict(params), ds, num_boost_round=20)
+    sweep = np.linspace(-1, 1, 21)
+    for cat in range(cats):
+        grid = np.column_stack([np.full(len(sweep), float(cat)), sweep])
+        assert np.all(np.diff(bst.predict(grid)) >= -1e-6), \
+            f"monotone violated within category {cat}"

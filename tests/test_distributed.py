@@ -87,7 +87,10 @@ def test_voting_parallel_matches_serial_when_topk_covers_features():
     must reproduce the serial learner exactly."""
     X, y = make_regression(1024, 8)
     params = {"objective": "regression", "num_leaves": 15,
-              "min_data_in_leaf": 5, "verbosity": -1, "top_k": 20}
+              "min_data_in_leaf": 5, "verbosity": -1, "top_k": 20,
+              # the sharded learners grow exact leaf-wise; compare
+              # against the serial EXACT grower, not the waved default
+              "tpu_wave_max": 0}
     serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
                        num_boost_round=10)
     voting = lgb.train({**params, "tree_learner": "voting"},
@@ -111,7 +114,9 @@ def test_feature_parallel_matches_serial_exactly():
     """Feature-parallel is exact: same candidate set, sharded search."""
     X, y = make_regression(1024, 10)
     params = {"objective": "regression", "num_leaves": 15,
-              "min_data_in_leaf": 5, "verbosity": -1, "seed": 3}
+              "min_data_in_leaf": 5, "verbosity": -1, "seed": 3,
+              # serial baseline must be the EXACT grower (see above)
+              "tpu_wave_max": 0}
     serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
                        num_boost_round=10)
     fpar = lgb.train({**params, "tree_learner": "feature"},

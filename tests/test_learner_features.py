@@ -198,10 +198,10 @@ class TestPredictionEarlyStop:
         # the f32 device ensemble — agreement at f32 resolution
         np.testing.assert_allclose(exact, full, rtol=1e-4, atol=1e-6)
 
-        bst._gbdt.config.pred_early_stop_margin = 0.5
+        bst._gbdt.config.pred_early_stop_margin = 1.0  # stops at 2|raw|>1
         approx = bst.predict(X, raw_score=True)
         # decisions agree even where magnitudes were truncated
-        assert np.mean((approx > 0) == (full > 0)) > 0.98
+        assert np.mean((approx > 0) == (full > 0)) > 0.97
         # margin-exceeding rows really did stop early
         assert np.any(np.abs(approx) < np.abs(full) - 1e-12)
         bst._gbdt.config.pred_early_stop = False

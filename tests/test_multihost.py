@@ -109,3 +109,24 @@ def _auc(y, s):
     pos = y > 0.5
     return (ranks[pos].sum() - pos.sum() * (pos.sum() + 1) / 2) / (
         pos.sum() * (~pos).sum())
+
+
+def test_cluster_train_distributed():
+    """cluster.train_distributed: the dask-orchestration analog — spawn
+    a local worker per partition, train across them, get one model
+    (ref: dask.py LocalCluster test pattern, test_dask.py)."""
+    from lightgbm_tpu.cluster import train_distributed
+
+    rng = np.random.RandomState(7)
+    n, f = 600, 5
+    X = rng.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.4).astype(np.float32)
+    parts = [{"X": X[:300], "y": y[:300]},
+             {"X": X[300:], "y": y[300:]}]
+    bst = train_distributed(
+        {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbosity": -1, "max_bin": 63},
+        parts, num_boost_round=6, devices_per_worker=2)
+    assert bst.num_trees() == 6
+    auc = _auc(y, bst.predict(X))
+    assert auc > 0.85, auc

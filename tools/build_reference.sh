@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Build the reference LightGBM CLI from /root/reference in this offline
+# image. The vendored submodules (fmt, fast_double_parser, eigen,
+# nanoarrow, compute) are empty, so small build shims from
+# tools/ref_shims/ are injected via a symlink shadow tree; the top
+# CMakeLists' cmake_minimum_required is lowered to match the image's
+# cmake. Produces /tmp/lgbsrc/lightgbm (used by gen_reference_golden.py).
+set -euo pipefail
+
+SRC=/tmp/lgbsrc
+BUILD=/tmp/lgbref
+REF=/root/reference
+SHIMS="$(cd "$(dirname "$0")/ref_shims" && pwd)"
+
+rm -rf "$SRC" "$BUILD"
+mkdir -p "$SRC"
+for f in "$REF"/* ; do
+  ln -s "$f" "$SRC/$(basename "$f")"
+done
+rm "$SRC/CMakeLists.txt" "$SRC/external_libs"
+sed 's/cmake_minimum_required(VERSION 3.28)/cmake_minimum_required(VERSION 3.25)/' \
+    "$REF/CMakeLists.txt" > "$SRC/CMakeLists.txt"
+
+E="$SRC/external_libs"
+mkdir -p "$E/fast_double_parser/include" "$E/fmt/include/fmt" \
+         "$E/eigen/Eigen" "$E/nanoarrow/include/nanoarrow" \
+         "$E/compute/include"
+cp "$SHIMS/fast_double_parser.h" "$E/fast_double_parser/include/"
+cp "$SHIMS/fmt_format.h" "$E/fmt/include/fmt/format.h"
+cp "$SHIMS/eigen_dense.h" "$E/eigen/Eigen/Dense"
+cp "$SHIMS/nanoarrow.hpp" "$E/nanoarrow/include/nanoarrow/nanoarrow.hpp"
+cat > "$E/nanoarrow/CMakeLists.txt" <<'EOF'
+cmake_minimum_required(VERSION 3.25)
+project(nanoarrow_shim C)
+add_library(nanoarrow_static STATIC nanoarrow_stub.c)
+target_include_directories(nanoarrow_static PUBLIC ${CMAKE_CURRENT_SOURCE_DIR}/include)
+EOF
+cat > "$E/nanoarrow/nanoarrow_stub.c" <<'EOF'
+/* nanoarrow shim: all functionality lives in the header. */
+int lgbm_nanoarrow_shim_anchor = 0;
+EOF
+
+cmake -S "$SRC" -B "$BUILD" -DCMAKE_BUILD_TYPE=Release
+cmake --build "$BUILD" -j"$(nproc)"
+ls -la "$SRC/lightgbm"
+echo "reference CLI: $SRC/lightgbm"

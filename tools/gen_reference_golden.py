@@ -103,7 +103,7 @@ def run_and_parse(binary: Path, conf: Path, cwd: Path) -> dict:
 
 def main() -> int:
     ap = argparse.ArgumentParser()
-    ap.add_argument("--binary", default="/tmp/lgbref/lightgbm")
+    ap.add_argument("--binary", default="/tmp/lgbsrc/lightgbm")
     ap.add_argument("--out", default=str(REPO / "tests/data/reference_golden.json"))
     args = ap.parse_args()
 

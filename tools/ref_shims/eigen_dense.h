@@ -1,0 +1,119 @@
+// Build shim for the vendored Eigen (submodule not present in this offline
+// environment). LightGBM's linear tree learner uses only:
+//   MatrixXd(r, c), operator()(i, j), operator()(i),
+//   m.fullPivLu().inverse(), operator* (matmul), unary minus.
+// Inverse is Gauss-Jordan with partial pivoting — same algorithm family as
+// Eigen's FullPivLU; results agree to machine precision on the
+// well-conditioned normal-equation matrices the linear learner builds.
+#ifndef EIGEN_DENSE_SHIM_H_
+#define EIGEN_DENSE_SHIM_H_
+
+#include <cmath>
+#include <cstddef>
+#include <utility>
+#include <vector>
+
+namespace Eigen {
+
+class FullPivLU;
+
+class MatrixXd {
+ public:
+  MatrixXd() : rows_(0), cols_(0) {}
+  MatrixXd(std::ptrdiff_t r, std::ptrdiff_t c)
+      : rows_(r), cols_(c), data_(static_cast<size_t>(r * c), 0.0) {}
+
+  double& operator()(std::ptrdiff_t i, std::ptrdiff_t j) {
+    return data_[static_cast<size_t>(i * cols_ + j)];
+  }
+  double operator()(std::ptrdiff_t i, std::ptrdiff_t j) const {
+    return data_[static_cast<size_t>(i * cols_ + j)];
+  }
+  // single-index access (column vectors)
+  double& operator()(std::ptrdiff_t i) { return data_[static_cast<size_t>(i)]; }
+  double operator()(std::ptrdiff_t i) const {
+    return data_[static_cast<size_t>(i)];
+  }
+
+  std::ptrdiff_t rows() const { return rows_; }
+  std::ptrdiff_t cols() const { return cols_; }
+
+  MatrixXd operator*(const MatrixXd& o) const {
+    MatrixXd out(rows_, o.cols_);
+    for (std::ptrdiff_t i = 0; i < rows_; ++i) {
+      for (std::ptrdiff_t k = 0; k < cols_; ++k) {
+        double a = (*this)(i, k);
+        if (a == 0.0) continue;
+        for (std::ptrdiff_t j = 0; j < o.cols_; ++j) {
+          out(i, j) += a * o(k, j);
+        }
+      }
+    }
+    return out;
+  }
+
+  MatrixXd operator-() const {
+    MatrixXd out = *this;
+    for (auto& v : out.data_) v = -v;
+    return out;
+  }
+
+  inline FullPivLU fullPivLu() const;
+
+  std::ptrdiff_t rows_, cols_;
+  std::vector<double> data_;
+};
+
+class FullPivLU {
+ public:
+  explicit FullPivLU(const MatrixXd& m) : m_(m) {}
+
+  MatrixXd inverse() const {
+    std::ptrdiff_t n = m_.rows();
+    // augmented [A | I] Gauss-Jordan with partial (row) pivoting
+    MatrixXd a = m_;
+    MatrixXd inv(n, n);
+    for (std::ptrdiff_t i = 0; i < n; ++i) inv(i, i) = 1.0;
+    for (std::ptrdiff_t col = 0; col < n; ++col) {
+      std::ptrdiff_t piv = col;
+      double best = std::fabs(a(col, col));
+      for (std::ptrdiff_t r = col + 1; r < n; ++r) {
+        if (std::fabs(a(r, col)) > best) {
+          best = std::fabs(a(r, col));
+          piv = r;
+        }
+      }
+      if (best == 0.0) continue;  // singular direction: leave zeros
+      if (piv != col) {
+        for (std::ptrdiff_t j = 0; j < n; ++j) {
+          std::swap(a(col, j), a(piv, j));
+          std::swap(inv(col, j), inv(piv, j));
+        }
+      }
+      double d = a(col, col);
+      for (std::ptrdiff_t j = 0; j < n; ++j) {
+        a(col, j) /= d;
+        inv(col, j) /= d;
+      }
+      for (std::ptrdiff_t r = 0; r < n; ++r) {
+        if (r == col) continue;
+        double f = a(r, col);
+        if (f == 0.0) continue;
+        for (std::ptrdiff_t j = 0; j < n; ++j) {
+          a(r, j) -= f * a(col, j);
+          inv(r, j) -= f * inv(col, j);
+        }
+      }
+    }
+    return inv;
+  }
+
+ private:
+  MatrixXd m_;
+};
+
+inline FullPivLU MatrixXd::fullPivLu() const { return FullPivLU(*this); }
+
+}  // namespace Eigen
+
+#endif  // EIGEN_DENSE_SHIM_H_

@@ -1,0 +1,23 @@
+// Build shim for the vendored fast_double_parser (submodule not present in
+// this offline environment). strtod is correctly rounded per C11, matching
+// fast_double_parser's exact-parse contract; returns nullptr on failure so
+// LightGBM's AtofPrecise fallback logic is preserved.
+#ifndef FAST_DOUBLE_PARSER_SHIM_H_
+#define FAST_DOUBLE_PARSER_SHIM_H_
+
+#include <cerrno>
+#include <cstdlib>
+
+namespace fast_double_parser {
+
+inline const char* parse_number(const char* p, double* out) {
+  char* end = nullptr;
+  errno = 0;
+  *out = std::strtod(p, &end);
+  if (end == p) return nullptr;
+  return end;
+}
+
+}  // namespace fast_double_parser
+
+#endif  // FAST_DOUBLE_PARSER_SHIM_H_

@@ -1,0 +1,44 @@
+// Build shim for the vendored {fmt} (submodule not present in this offline
+// environment). LightGBM uses exactly one entry point:
+// fmt::format_to_n(buffer, n, format, value) with formats "{}", "{:g}",
+// "{:.17g}" (utils/common.h format_to_buf). snprintf equivalents are exact
+// for these cases ("%.17g" round-trips doubles; "%g" matches "{:g}").
+#ifndef FMT_FORMAT_SHIM_H_
+#define FMT_FORMAT_SHIM_H_
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <type_traits>
+
+namespace fmt {
+
+struct format_to_n_result {
+  char* out;
+  size_t size;
+};
+
+template <typename T>
+inline format_to_n_result format_to_n(char* buffer, size_t n,
+                                      const char* format, T value) {
+  int written = 0;
+  if (std::strstr(format, ".17g") != nullptr) {
+    written = std::snprintf(buffer, n, "%.17g", static_cast<double>(value));
+  } else if (std::strchr(format, 'g') != nullptr) {
+    written = std::snprintf(buffer, n, "%g", static_cast<double>(value));
+  } else if (std::is_floating_point<T>::value) {
+    written = std::snprintf(buffer, n, "%.17g", static_cast<double>(value));
+  } else if (std::is_signed<T>::value) {
+    written = std::snprintf(buffer, n, "%lld",
+                            static_cast<long long>(value));
+  } else {
+    written = std::snprintf(buffer, n, "%llu",
+                            static_cast<unsigned long long>(value));
+  }
+  size_t size = written < 0 ? n : static_cast<size_t>(written);
+  return {buffer + (size < n ? size : n), size};
+}
+
+}  // namespace fmt
+
+#endif  // FMT_FORMAT_SHIM_H_

@@ -1,0 +1,206 @@
+// Build shim for the vendored nanoarrow (submodule not present in this
+// offline environment). Provides exactly the surface LightGBM's
+// src/arrow/array.hpp consumes: the Arrow C data interface structs (a public
+// ABI spec), a minimal ArrowSchemaView with format-string parsing for the
+// primitive types LightGBM supports, and RAII Unique* holders. Functional —
+// the Arrow ingestion C API works for primitive arrays — though the CLI
+// (the artifact this build exists for) never exercises it.
+#ifndef NANOARROW_SHIM_HPP_
+#define NANOARROW_SHIM_HPP_
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <stdexcept>
+#include <string>
+#include <utility>
+
+#ifndef ARROW_C_DATA_INTERFACE
+#define ARROW_C_DATA_INTERFACE
+
+#define ARROW_FLAG_DICTIONARY_ORDERED 1
+#define ARROW_FLAG_NULLABLE 2
+#define ARROW_FLAG_MAP_KEYS_SORTED 4
+
+struct ArrowSchema {
+  const char* format;
+  const char* name;
+  const char* metadata;
+  int64_t flags;
+  int64_t n_children;
+  struct ArrowSchema** children;
+  struct ArrowSchema* dictionary;
+  void (*release)(struct ArrowSchema*);
+  void* private_data;
+};
+
+struct ArrowArray {
+  int64_t length;
+  int64_t null_count;
+  int64_t offset;
+  int64_t n_buffers;
+  int64_t n_children;
+  const void** buffers;
+  struct ArrowArray** children;
+  struct ArrowArray* dictionary;
+  void (*release)(struct ArrowArray*);
+  void* private_data;
+};
+
+#endif  // ARROW_C_DATA_INTERFACE
+
+#ifndef ARROW_C_STREAM_INTERFACE
+#define ARROW_C_STREAM_INTERFACE
+
+struct ArrowArrayStream {
+  int (*get_schema)(struct ArrowArrayStream*, struct ArrowSchema* out);
+  int (*get_next)(struct ArrowArrayStream*, struct ArrowArray* out);
+  const char* (*get_last_error)(struct ArrowArrayStream*);
+  void (*release)(struct ArrowArrayStream*);
+  void* private_data;
+};
+
+#endif  // ARROW_C_STREAM_INTERFACE
+
+#define NANOARROW_OK 0
+
+enum ArrowType {
+  NANOARROW_TYPE_UNINITIALIZED = 0,
+  NANOARROW_TYPE_BOOL,
+  NANOARROW_TYPE_INT8,
+  NANOARROW_TYPE_INT16,
+  NANOARROW_TYPE_INT32,
+  NANOARROW_TYPE_INT64,
+  NANOARROW_TYPE_UINT8,
+  NANOARROW_TYPE_UINT16,
+  NANOARROW_TYPE_UINT32,
+  NANOARROW_TYPE_UINT64,
+  NANOARROW_TYPE_FLOAT,
+  NANOARROW_TYPE_DOUBLE,
+  NANOARROW_TYPE_STRUCT,
+  NANOARROW_TYPE_UNKNOWN,
+};
+
+struct ArrowError {
+  char message[1024];
+};
+
+struct ArrowSchemaView {
+  enum ArrowType type;
+};
+
+inline const char* ArrowErrorMessage(struct ArrowError* error) {
+  return error->message;
+}
+
+inline const char* ArrowTypeString(enum ArrowType type) {
+  switch (type) {
+    case NANOARROW_TYPE_BOOL: return "bool";
+    case NANOARROW_TYPE_INT8: return "int8";
+    case NANOARROW_TYPE_INT16: return "int16";
+    case NANOARROW_TYPE_INT32: return "int32";
+    case NANOARROW_TYPE_INT64: return "int64";
+    case NANOARROW_TYPE_UINT8: return "uint8";
+    case NANOARROW_TYPE_UINT16: return "uint16";
+    case NANOARROW_TYPE_UINT32: return "uint32";
+    case NANOARROW_TYPE_UINT64: return "uint64";
+    case NANOARROW_TYPE_FLOAT: return "float";
+    case NANOARROW_TYPE_DOUBLE: return "double";
+    case NANOARROW_TYPE_STRUCT: return "struct";
+    default: return "unknown";
+  }
+}
+
+inline int ArrowSchemaViewInit(struct ArrowSchemaView* view,
+                               const struct ArrowSchema* schema,
+                               struct ArrowError* error) {
+  const char* f = schema ? schema->format : nullptr;
+  if (f == nullptr) {
+    if (error) std::snprintf(error->message, sizeof(error->message),
+                             "null schema/format");
+    return 1;
+  }
+  if (std::strcmp(f, "b") == 0) view->type = NANOARROW_TYPE_BOOL;
+  else if (std::strcmp(f, "c") == 0) view->type = NANOARROW_TYPE_INT8;
+  else if (std::strcmp(f, "s") == 0) view->type = NANOARROW_TYPE_INT16;
+  else if (std::strcmp(f, "i") == 0) view->type = NANOARROW_TYPE_INT32;
+  else if (std::strcmp(f, "l") == 0) view->type = NANOARROW_TYPE_INT64;
+  else if (std::strcmp(f, "C") == 0) view->type = NANOARROW_TYPE_UINT8;
+  else if (std::strcmp(f, "S") == 0) view->type = NANOARROW_TYPE_UINT16;
+  else if (std::strcmp(f, "I") == 0) view->type = NANOARROW_TYPE_UINT32;
+  else if (std::strcmp(f, "L") == 0) view->type = NANOARROW_TYPE_UINT64;
+  else if (std::strcmp(f, "f") == 0) view->type = NANOARROW_TYPE_FLOAT;
+  else if (std::strcmp(f, "g") == 0) view->type = NANOARROW_TYPE_DOUBLE;
+  else if (std::strcmp(f, "+s") == 0) view->type = NANOARROW_TYPE_STRUCT;
+  else view->type = NANOARROW_TYPE_UNKNOWN;
+  return NANOARROW_OK;
+}
+
+inline bool ArrowBitGet(const uint8_t* bits, int64_t i) {
+  return (bits[i >> 3] >> (i & 0x07)) & 1;
+}
+
+namespace nanoarrow {
+
+class Exception : public std::runtime_error {
+ public:
+  explicit Exception(const std::string& msg) : std::runtime_error(msg) {}
+};
+
+namespace internal {
+
+inline void release(struct ArrowSchema* s) {
+  if (s && s->release) s->release(s);
+}
+inline void release(struct ArrowArray* a) {
+  if (a && a->release) a->release(a);
+}
+inline void release(struct ArrowArrayStream* st) {
+  if (st && st->release) st->release(st);
+}
+
+// RAII holder over an Arrow C struct; move-only; calls release on destroy.
+template <typename T>
+class Unique {
+ public:
+  Unique() { std::memset(&data_, 0, sizeof(T)); }
+  // Takes ownership of *ptr: moves the struct in and marks the source
+  // released (standard Arrow C ABI ownership transfer).
+  explicit Unique(T* ptr) {
+    std::memcpy(&data_, ptr, sizeof(T));
+    ptr->release = nullptr;
+  }
+  Unique(Unique&& o) noexcept {
+    std::memcpy(&data_, &o.data_, sizeof(T));
+    o.data_.release = nullptr;
+  }
+  Unique& operator=(Unique&& o) noexcept {
+    if (this != &o) {
+      release(&data_);
+      std::memcpy(&data_, &o.data_, sizeof(T));
+      o.data_.release = nullptr;
+    }
+    return *this;
+  }
+  Unique(const Unique&) = delete;
+  Unique& operator=(const Unique&) = delete;
+  ~Unique() { release(&data_); }
+
+  T* get() { return &data_; }
+  const T* get() const { return &data_; }
+  T* operator->() { return &data_; }
+  const T* operator->() const { return &data_; }
+
+ private:
+  T data_;
+};
+
+}  // namespace internal
+
+using UniqueSchema = internal::Unique<struct ArrowSchema>;
+using UniqueArray = internal::Unique<struct ArrowArray>;
+using UniqueArrayStream = internal::Unique<struct ArrowArrayStream>;
+
+}  // namespace nanoarrow
+
+#endif  // NANOARROW_SHIM_HPP_
