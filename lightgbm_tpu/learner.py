@@ -13,6 +13,14 @@ Key correspondences:
   - smaller-child build + sibling subtraction ~ serial_tree_learner.cpp:373,582
   - per-leaf best-split arrays ~ best_split_per_leaf_
   - row_leaf vector ~ CUDADataPartition's cuda_data_index_to_leaf_index_
+
+Memory stance on the pool: the reference bounds host RAM with an LRU
+cache (histogram_pool_size) and recomputes evicted histograms. Static
+XLA shapes preclude an LRU; the full [L, F, B, 3] pool is kept in HBM
+(5.5 MB at Higgs shape, ~784 MB worst-case at 255 leaves x 1k features
+x 256 bins — well inside a 16 GB chip, and EFB bundling shrinks F for
+exactly the wide datasets that would push it). Only one grower's pool
+is live at a time; the buffer is freed when its program ends.
 """
 
 from __future__ import annotations
