@@ -13,17 +13,130 @@ The dataset is synthetic with Higgs shape (28 features, N rows; the real
 Higgs is not redistributable and this environment has no egress). Row
 count defaults to 10.5M (override with BENCH_ROWS) so iters/sec is
 directly comparable to the published 3.843.
+
+Resilience: the TPU is reached through a fragile local relay that has
+died mid-round before ("Unable to initialize backend 'axon'" killed the
+round-3 bench before a single tree trained). main() therefore
+orchestrates the actual measurement in a child process: it probes the
+relay port first, retries a crashed attempt, shrinks the row count if
+the full-size run dies, and finally falls back to a CPU run on a small
+shard — so ONE JSON line is always emitted, with the actual row count
+and platform recorded in `unit`.
 """
 
 import json
 import os
+import signal
+import socket
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+BASELINE_IPS = 500.0 / 130.094  # reference CPU Higgs-10.5M iters/sec
+RELAY_PORTS = (8082, 8083, 8087)
+
+
+def _relay_up() -> bool:
+    """True if the axon TPU relay is accepting connections."""
+    for port in RELAY_PORTS:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=3):
+                return True
+        except OSError:
+            continue
+    return False
+
+
+def _run_child(rows: int, platform: str, timeout: float,
+               out_path: str) -> int:
+    """Run one measurement attempt in a child; return its exit code.
+
+    The child writes its JSON result line to `out_path` (not stdout):
+    an abandoned timed-out child that later recovers must not be able
+    to inject a second contract line onto the driver's stdout.
+
+    Timeouts use SIGTERM + grace, never SIGKILL: force-killing a process
+    attached to the TPU relay wedges the relay for the rest of the round.
+    """
+    if platform == "cpu":
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        from lightgbm_tpu.hostenv import cpu_child_env
+        env = cpu_child_env()
+    else:
+        env = dict(os.environ)
+    env["BENCH_CHILD"] = "1"
+    env["BENCH_ROWS"] = str(rows)
+    env["BENCH_OUT"] = out_path
+    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
+                            env=env)
+    try:
+        return proc.wait(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"# bench attempt timed out after {timeout:.0f}s "
+              f"(rows={rows}, platform={platform}); SIGTERM",
+              file=sys.stderr)
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            # Leave it; do NOT SIGKILL a TPU-attached process.
+            print("# child ignored SIGTERM; abandoning it", file=sys.stderr)
+        return -1
+
 
 def main():
+    requested = int(os.environ.get("BENCH_ROWS", 10_500_000))
+    budget = float(os.environ.get("BENCH_TRY_TIMEOUT", 1200))
+
+    attempts = []
+    if _relay_up():
+        attempts.append((requested, "axon", budget))
+        if requested > 1_000_000:
+            attempts.append((1_000_000, "axon", budget / 2))
+    else:
+        print("# axon relay not listening on 127.0.0.1:8082+; "
+              "skipping TPU attempts", file=sys.stderr)
+    # CPU fallback: tiny shard so the 1-core host finishes. Clearly
+    # flagged via platform=cpu in the child's `unit` string.
+    attempts.append((min(requested, 100_000), "cpu", budget * 0.75))
+
+    import tempfile
+    queue = list(attempts)
+    i = 0
+    while queue:
+        rows, platform, timeout = queue.pop(0)
+        with tempfile.NamedTemporaryFile("r", suffix=".json") as tf:
+            rc = _run_child(rows, platform, timeout, tf.name)
+            line = tf.read().strip()
+        if rc == 0 and line:
+            print(line, flush=True)
+            return
+        print(f"# bench attempt {i} failed rc={rc} "
+              f"(rows={rows}, platform={platform})", file=sys.stderr)
+        i += 1
+        if platform == "axon":
+            if rc == -1:
+                # the TPU path HUNG (wedged relay) rather than crashed:
+                # further TPU attempts would hang the same way — go
+                # straight to the CPU fallback
+                queue = [a for a in queue if a[1] != "axon"]
+            else:
+                time.sleep(20)  # give a flapping relay a moment
+
+    # Everything failed — still emit the contract line so the driver
+    # records a structured result instead of a crash.
+    print(json.dumps({
+        "metric": "boosting_iters_per_sec_higgs_shape",
+        "value": 0.0,
+        "unit": "iters/sec (all attempts failed; see stderr)",
+        "vs_baseline": 0.0,
+    }))
+    sys.exit(1)
+
+
+def _measure():
     n = int(os.environ.get("BENCH_ROWS", 10_500_000))
     f = 28
     iters = int(os.environ.get("BENCH_ITERS", 10))
@@ -32,13 +145,14 @@ def main():
     import jax
     import lightgbm_tpu as lgb
 
+    platform = jax.default_backend()
     rng = np.random.RandomState(0)
     # Higgs-like: mix of informative and noise features, ~53% positive
     x = rng.randn(n, f).astype(np.float32)
     logit = (x[:, 0] + 0.6 * x[:, 1] ** 2 + 0.4 * x[:, 2] * x[:, 3]
              - 0.3 * np.abs(x[:, 4]) + 0.5 * rng.randn(n))
     y = (logit > 0.2).astype(np.float32)
-    n_test = 200_000
+    n_test = min(200_000, n)
     xt = rng.randn(n_test, f).astype(np.float32)
     lt = (xt[:, 0] + 0.6 * xt[:, 1] ** 2 + 0.4 * xt[:, 2] * xt[:, 3]
           - 0.3 * np.abs(xt[:, 4]) + 0.5 * rng.randn(n_test))
@@ -74,14 +188,22 @@ def main():
     dt = (time.time() - t0) / iters
 
     iters_per_sec = 1.0 / dt
-    baseline = 500.0 / 130.094  # reference CPU Higgs iters/sec
+    unit = "iters/sec (N=%d, 255 leaves, 63 bins" % n
+    if platform != "tpu":
+        unit += ", platform=%s" % platform
+    unit += ")"
     result = {
         "metric": "boosting_iters_per_sec_higgs_shape",
         "value": round(iters_per_sec, 4),
-        "unit": "iters/sec (N=%d, 255 leaves, 63 bins)" % n,
-        "vs_baseline": round(iters_per_sec / baseline, 4),
+        "unit": unit,
+        "vs_baseline": round(iters_per_sec / BASELINE_IPS, 4),
     }
-    print(json.dumps(result))
+    out_path = os.environ.get("BENCH_OUT")
+    if out_path:  # orchestrated: parent prints the single contract line
+        with open(out_path, "w") as fh:
+            fh.write(json.dumps(result) + "\n")
+    else:
+        print(json.dumps(result), flush=True)
     # quality sanity: held-out AUC after the benchmarked iterations — a
     # guard on the bf16-input histogram path (tpu_hist_precision default)
     try:
@@ -95,9 +217,13 @@ def main():
         auc_line = f"test_auc@{warmup + iters}iters={auc:.4f}"
     except Exception as exc:  # never let the sanity check kill the bench
         auc_line = f"auc_check_failed={exc!r}"
-    print(f"# bin={bin_time:.1f}s warmup+compile={warm_time:.1f}s "
-          f"per_iter={dt:.3f}s {auc_line}", file=sys.stderr)
+    print(f"# platform={platform} bin={bin_time:.1f}s "
+          f"warmup+compile={warm_time:.1f}s per_iter={dt:.3f}s {auc_line}",
+          file=sys.stderr)
 
 
 if __name__ == "__main__":
-    main()
+    if os.environ.get("BENCH_CHILD"):
+        _measure()
+    else:
+        main()

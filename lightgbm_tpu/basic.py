@@ -360,6 +360,7 @@ class Booster:
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration; True means training should stop
         (ref: basic.py Booster.update -> LGBM_BoosterUpdateOneIter)."""
+        self._ensure_network()
         if fobj is not None:
             grad, hess = fobj(self._raw_train_scores(), self.train_set)
             return self._gbdt.train_one_iter(np.asarray(grad),
@@ -519,11 +520,15 @@ class Booster:
                               max(self._loaded.num_tree_per_iteration, 1)]
             for tree in trees:
                 for i in range(tree.num_internal):
+                    # the reference only counts splits with positive gain
+                    # (ref: GBDT::FeatureImportance gbdt_model_text.cpp)
+                    if float(tree.split_gain[i]) <= 0.0:
+                        continue
                     f = int(tree.split_feature[i])
                     if importance_type == "split":
                         out[f] += 1.0
                     else:
-                        out[f] += max(float(tree.split_gain[i]), 0.0)
+                        out[f] += float(tree.split_gain[i])
             return out
         return self._gbdt.feature_importance(importance_type, iteration)
 
@@ -552,26 +557,59 @@ class Booster:
         locates itself in mlist.txt)."""
         from . import log
         from .parallel import distributed as dist
-        self._network_params = dict(machines=machines,
-                                    local_listen_port=local_listen_port,
-                                    num_machines=num_machines)
         if (not num_machines or int(num_machines) <= 1) and machines:
             # reference configs often leave num_machines at 1 and rely
             # on the machine list length
             num_machines = len(dist.parse_machine_list(machines))
-        if num_machines and int(num_machines) > 1:
-            import os
-            if os.environ.get("LGBM_TPU_RANK") is None:
-                log.warning(
-                    "set_network: machine list given but LGBM_TPU_RANK is "
-                    "unset — cannot determine this process's rank, so the "
-                    "distributed runtime was NOT initialized; set "
-                    "LGBM_TPU_RANK or call parallel.distributed."
-                    "init_distributed(process_id=...) directly")
-            else:
-                dist.init_distributed(machines=machines,
-                                      num_processes=int(num_machines))
+        # Like the reference's SetNetwork, only RECORD the config here;
+        # joining the runtime blocks until all ranks arrive, so it is
+        # deferred to the first update() (see _ensure_network) instead of
+        # hanging API-compat callers at set_network time.
+        self._network_params = dict(machines=machines,
+                                    local_listen_port=local_listen_port,
+                                    listen_time_out=listen_time_out,
+                                    num_machines=num_machines)
+        import os
+        if (num_machines and int(num_machines) > 1
+                and os.environ.get("LGBM_TPU_RANK") is None
+                and not dist.is_initialized()):
+            log.warning(
+                "set_network: machine list given but LGBM_TPU_RANK is "
+                "unset — cannot determine this process's rank, so the "
+                "distributed runtime will NOT be initialized; set "
+                "LGBM_TPU_RANK or call parallel.distributed."
+                "init_distributed(process_id=...) directly")
         return self
+
+    def _ensure_network(self) -> None:
+        """Join the recorded machine list at training start (deferred
+        from set_network; no-op when the runtime is already up)."""
+        from . import log
+        from .parallel import distributed as dist
+        np_ = self._network_params
+        if not np_ or dist.is_initialized():
+            return
+        num_machines = np_.get("num_machines") or 1
+        if int(num_machines) <= 1:
+            return
+        import os
+        if os.environ.get("LGBM_TPU_RANK") is None:
+            return  # already warned at set_network time
+        timeout_min = np_.get("listen_time_out")
+        try:
+            dist.init_distributed(
+                machines=np_["machines"],
+                num_processes=int(num_machines),
+                # listen_time_out follows the reference's unit (minutes,
+                # config.h time_out); jax wants seconds
+                initialization_timeout=(None if timeout_min is None
+                                        else float(timeout_min) * 60.0))
+        except RuntimeError as exc:
+            if "already initialized" in str(exc).lower():
+                # the caller brought up the JAX runtime themselves — fine
+                log.warning(f"set_network: distributed init skipped: {exc}")
+            else:
+                raise
 
     def shuffle_models(self, start_iteration=0, end_iteration=-1) -> "Booster":
         models = self._gbdt.models

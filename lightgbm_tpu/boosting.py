@@ -1065,11 +1065,15 @@ class GBDT:
                     if tree.left_child[nd] == -1 and \
                             tree.right_child[nd] == -1:
                         continue
+                    # only positive-gain splits count (ref:
+                    # GBDT::FeatureImportance gbdt_model_text.cpp)
+                    if tree.split_gain[nd] <= 0.0:
+                        continue
                     f = tree.split_feature[nd]
                     if importance_type == "split":
                         imp[f] += 1
                     else:
-                        imp[f] += max(tree.split_gain[nd], 0.0)
+                        imp[f] += tree.split_gain[nd]
         return imp
 
     @property

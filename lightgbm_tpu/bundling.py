@@ -73,6 +73,11 @@ def find_bundles(nonzero_masks: np.ndarray, num_bins: np.ndarray,
     f, s = nonzero_masks.shape
     max_conflicts = int(max_conflict_rate * s)
     order = np.argsort(-nonzero_masks.sum(axis=1, dtype=np.int64))
+    # cap the per-feature candidate search like the reference's
+    # max_search_group (ref: dataset.cpp:118 FindGroups) — without it,
+    # wide data where most features conflict degrades quadratically
+    max_search = 100
+    search_rng = np.random.RandomState(3)
 
     bundle_members: List[List[int]] = []
     bundle_masks: List[np.ndarray] = []
@@ -83,7 +88,13 @@ def find_bundles(nonzero_masks: np.ndarray, num_bins: np.ndarray,
         width = int(num_bins[feat]) - 1  # non-default bins it adds
         placed = False
         if bundleable is None or bundleable[feat]:
-            for g in range(len(bundle_members)):
+            n_groups = len(bundle_members)
+            if n_groups > max_search:
+                candidates = search_rng.choice(n_groups, max_search,
+                                               replace=False)
+            else:
+                candidates = range(n_groups)
+            for g in candidates:
                 if bundle_masks[g] is None:  # singleton-only bundle
                     continue
                 if bundle_bins[g] + width + 1 > max_bundle_bins:

@@ -25,6 +25,7 @@ import socket
 import subprocess
 import sys
 import tempfile
+import time
 from pathlib import Path
 from typing import Any, Dict, List, Optional
 
@@ -72,14 +73,8 @@ def worker_train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
 _WORKER_MAIN = """
 import os, pickle, sys
 payload = pickle.load(open(sys.argv[1], "rb"))
-os.environ["JAX_PLATFORMS"] = "cpu"
-# override any inherited device-count flag: each worker gets exactly
-# devices_per_worker virtual devices
-flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
-         if "xla_force_host_platform_device_count" not in f]
-flags.append("--xla_force_host_platform_device_count="
-             + str(payload["devices_per_worker"]))
-os.environ["XLA_FLAGS"] = " ".join(flags)
+# env (JAX_PLATFORMS=cpu, device count, no axon plugin) is prepared by
+# the parent via hostenv.cpu_child_env — one copy of that recipe
 import jax
 jax.config.update("jax_platforms", "cpu")
 sys.path[:0] = payload["sys_path"]
@@ -124,7 +119,8 @@ def train_distributed(params: Dict[str, Any], parts: List[Dict[str, Any]],
         out_model = os.path.join(td, "model.txt")
         payload = {
             "params": dict(params),
-            "parts": [{k: np.asarray(v) for k, v in p.items()}
+            "parts": [{k: np.asarray(v) for k, v in p.items()
+                       if v is not None}
                       for p in parts],
             "coordinator": f"127.0.0.1:{port}",
             "num_boost_round": int(num_boost_round),
@@ -138,19 +134,28 @@ def train_distributed(params: Dict[str, Any], parts: List[Dict[str, Any]],
         main_py = os.path.join(td, "worker_main.py")
         Path(main_py).write_text(_WORKER_MAIN)
 
+        # per-rank log files, not PIPEs: a worker that fills a ~64KB
+        # pipe buffer blocks on write inside a collective and stalls
+        # the whole gang until the timeout reaps it
+        logs = [open(os.path.join(td, f"worker{rank}.log"), "w+")
+                for rank in range(len(parts))]
+        from .hostenv import cpu_child_env
+        worker_env = cpu_child_env(int(devices_per_worker))
         procs = [subprocess.Popen(
             [sys.executable, main_py, blob, str(rank)],
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
-            for rank in range(len(parts))]
+            stdout=log, stderr=subprocess.STDOUT, text=True,
+            env=worker_env)
+            for rank, log in enumerate(logs)]
         try:
-            outs = []
+            deadline = time.monotonic() + timeout
             for proc in procs:
-                out, _ = proc.communicate(timeout=timeout)
-                outs.append(out)
-            failed = [(r, out) for r, (proc, out) in
-                      enumerate(zip(procs, outs)) if proc.returncode != 0]
+                proc.wait(timeout=max(1.0, deadline - time.monotonic()))
+            failed = [r for r, proc in enumerate(procs)
+                      if proc.returncode != 0]
             if failed:
-                r, out = failed[0]
+                r = failed[0]
+                logs[r].seek(0)
+                out = logs[r].read()
                 raise RuntimeError(
                     f"distributed worker {r} failed:\n{out[-4000:]}")
         finally:
@@ -160,4 +165,6 @@ def train_distributed(params: Dict[str, Any], parts: List[Dict[str, Any]],
                 if proc.poll() is None:
                     proc.kill()
                     proc.wait()
+            for log in logs:
+                log.close()
         return Booster(model_file=out_model)

@@ -1,0 +1,34 @@
+"""Environment helpers for spawning CPU-only helper processes.
+
+The TPU is reached through a fragile local relay; the axon PJRT plugin
+registered by this image's sitecustomize hangs in a nanosleep retry
+loop if anything touches the backend while the relay is down. Every
+subprocess that should run on CPU (cluster workers, the multichip
+dryrun, the bench fallback) must therefore (a) pin JAX_PLATFORMS=cpu
+and (b) drop PALLAS_AXON_POOL_IPS so the plugin is never registered at
+interpreter startup.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+
+def cpu_child_env(n_devices: Optional[int] = None,
+                  base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """A copy of the environment made safe for a CPU-only child.
+
+    n_devices: when given, force that many virtual CPU devices via
+    --xla_force_host_platform_device_count (replacing any inherited
+    setting of that flag).
+    """
+    env = dict(os.environ if base is None else base)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    if n_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env

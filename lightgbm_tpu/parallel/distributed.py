@@ -52,7 +52,8 @@ def init_distributed(coordinator_address: Optional[str] = None,
                      num_processes: Optional[int] = None,
                      process_id: Optional[int] = None,
                      machines=None,
-                     local_device_ids=None) -> None:
+                     local_device_ids=None,
+                     initialization_timeout: Optional[float] = None) -> None:
     """Join this process into the global JAX runtime.
 
     Either pass `coordinator_address`/`num_processes`/`process_id`
@@ -79,11 +80,15 @@ def init_distributed(coordinator_address: Optional[str] = None,
                 "worker must know its rank, like each reference worker "
                 "finds itself in mlist.txt")
         process_id = int(env_rank)
+    kwargs = {}
+    if initialization_timeout is not None:
+        kwargs["initialization_timeout"] = int(initialization_timeout)
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,
         process_id=process_id,
-        local_device_ids=local_device_ids)
+        local_device_ids=local_device_ids,
+        **kwargs)
     _initialized = True
     log.info(f"distributed runtime up: process {process_id}/"
              f"{num_processes}, {len(jax.devices())} global devices "
