@@ -17,6 +17,8 @@ from . import plotting  # noqa: F401
 from .plotting import (create_tree_digraph, plot_importance,  # noqa: F401
                        plot_metric, plot_split_value_histogram, plot_tree)
 from .io.streaming import DatasetBuilder  # noqa: F401
+from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
+                      LGBMRanker, LGBMRegressor)
 
 __version__ = "0.1.0"
 
@@ -27,4 +29,5 @@ __all__ = [
     "reset_parameter", "EarlyStopException", "register_logger",
     "plot_importance", "plot_metric", "plot_split_value_histogram",
     "plot_tree", "create_tree_digraph", "plotting", "DatasetBuilder",
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
 ]

@@ -29,7 +29,14 @@ class LightGBMError(Exception):
     """(ref: basic.py LightGBMError)"""
 
 
-def _to_2d(data) -> np.ndarray:
+from .dataset import is_sparse as _is_sparse
+
+
+def _to_2d(data):
+    if _is_sparse(data):
+        # kept sparse end-to-end (see BinnedDataset.from_sparse);
+        # normalized to CSR so row slicing (subset, cv folds) works
+        return data.tocsr()
     arr = np.asarray(data, dtype=np.float64)
     if arr.ndim == 1:
         arr = arr.reshape(-1, 1)
@@ -182,11 +189,18 @@ class Dataset:
 
         from .timer import global_timer
         with global_timer.timed("data/binning"):
-            self._binned = BinnedDataset.from_matrix(
-                self.data, cfg, metadata=meta,
-                categorical_features=cat_indices,
-                feature_names=names, reference=ref_binned,
-                forced_bins=forced_bins)
+            if _is_sparse(self.data):
+                self._binned = BinnedDataset.from_sparse(
+                    self.data, cfg, metadata=meta,
+                    categorical_features=cat_indices,
+                    feature_names=names, reference=ref_binned,
+                    forced_bins=forced_bins)
+            else:
+                self._binned = BinnedDataset.from_matrix(
+                    self.data, cfg, metadata=meta,
+                    categorical_features=cat_indices,
+                    feature_names=names, reference=ref_binned,
+                    forced_bins=forced_bins)
         return self
 
     def _feature_names(self) -> List[str]:
@@ -452,6 +466,20 @@ class Booster:
     def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        if _is_sparse(data):
+            # tree traversal reads raw feature values: densify in
+            # row batches so peak host memory stays bounded
+            from .dataset import sparse_row_batches
+            if data.shape[0] == 0:
+                data = np.zeros(data.shape)
+            else:
+                outs = [self.predict(b, start_iteration=start_iteration,
+                                     num_iteration=num_iteration,
+                                     raw_score=raw_score,
+                                     pred_leaf=pred_leaf,
+                                     pred_contrib=pred_contrib, **kwargs)
+                        for b in sparse_row_batches(data)]
+                return np.concatenate(outs, axis=0)
         data = np.asarray(data, dtype=np.float64)
         if data.ndim == 1:
             data = data.reshape(1, -1)

@@ -156,39 +156,8 @@ class BinMapper:
                 return self
 
             distinct, counts = np.unique(clean, return_counts=True)
-            # zero-as-one-bin (ref: bin.cpp:247): bin the negative and
-            # positive halves separately, keep [-eps, eps] as zero's own bin
-            neg = distinct < -K_ZERO_THRESHOLD
-            pos = distinct > K_ZERO_THRESHOLD
-            zero_cnt = int(counts[~neg & ~pos].sum())
-            n_neg, n_pos = int(neg.sum()), int(pos.sum())
-            total = int(counts.sum())
-            avail = max_bin - 1  # reserve NaN bin later via max_bin arg below
-            if self.missing_type == MISSING_NAN:
-                avail = max(avail, 1)
-            else:
-                avail = max_bin
-            # share bins between halves proportional to distinct counts
-            left_max = int(round(avail * n_neg / max(n_neg + n_pos, 1)))
-            left_max = min(max(left_max, 1 if n_neg else 0), avail - (1 if n_pos else 0))
-            right_max = avail - left_max - 1  # -1 for the zero bin
-            bounds = []
-            if n_neg:
-                lb = _greedy_find_bin(distinct[neg], counts[neg],
-                                      max(left_max, 1), int(counts[neg].sum()),
-                                      min_data_in_bin)
-                bounds.extend(b for b in lb[:-1])
-                bounds.append(-K_ZERO_THRESHOLD)
-            if n_pos:
-                bounds.append(K_ZERO_THRESHOLD)
-                rb = _greedy_find_bin(distinct[pos], counts[pos],
-                                      max(right_max, 1), int(counts[pos].sum()),
-                                      min_data_in_bin)
-                bounds.extend(b for b in rb[:-1])
-            elif zero_cnt or n_neg:
-                bounds.append(K_ZERO_THRESHOLD)
-            bounds.append(np.inf)
-            bounds = sorted(set(bounds))
+            bounds = self._bounds_from_distinct(distinct, counts, max_bin,
+                                                min_data_in_bin)
 
         self.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
         self.num_bins = len(bounds)
@@ -196,6 +165,138 @@ class BinMapper:
             self.num_bins += 1  # dedicated NaN bin at the end
         self._finalize_numerical(values, na_cnt)
         return self
+
+    def _bounds_from_distinct(self, distinct: np.ndarray, counts: np.ndarray,
+                              max_bin: int, min_data_in_bin: int) -> List[float]:
+        """Numerical bounds from sorted distinct values + counts.
+
+        Zero-as-one-bin (ref: bin.cpp:247): bin the negative and positive
+        halves separately, keep [-eps, eps] as zero's own bin. Shared by
+        the dense fit() path and fit_sparse() (which injects the implicit
+        zero count instead of materializing a dense column).
+        """
+        neg = distinct < -K_ZERO_THRESHOLD
+        pos = distinct > K_ZERO_THRESHOLD
+        zero_cnt = int(counts[~neg & ~pos].sum())
+        n_neg, n_pos = int(neg.sum()), int(pos.sum())
+        avail = max_bin - 1  # reserve NaN bin later via max_bin arg below
+        if self.missing_type == MISSING_NAN:
+            avail = max(avail, 1)
+        else:
+            avail = max_bin
+        # share bins between halves proportional to distinct counts
+        left_max = int(round(avail * n_neg / max(n_neg + n_pos, 1)))
+        left_max = min(max(left_max, 1 if n_neg else 0), avail - (1 if n_pos else 0))
+        right_max = avail - left_max - 1  # -1 for the zero bin
+        bounds: List[float] = []
+        if n_neg:
+            lb = _greedy_find_bin(distinct[neg], counts[neg],
+                                  max(left_max, 1), int(counts[neg].sum()),
+                                  min_data_in_bin)
+            bounds.extend(b for b in lb[:-1])
+            bounds.append(-K_ZERO_THRESHOLD)
+        if n_pos:
+            bounds.append(K_ZERO_THRESHOLD)
+            rb = _greedy_find_bin(distinct[pos], counts[pos],
+                                  max(right_max, 1), int(counts[pos].sum()),
+                                  min_data_in_bin)
+            bounds.extend(b for b in rb[:-1])
+        elif zero_cnt or n_neg:
+            bounds.append(K_ZERO_THRESHOLD)
+        bounds.append(np.inf)
+        return sorted(set(bounds))
+
+    def fit_sparse(self, nz_values: np.ndarray, num_rows: int, *,
+                   max_bin: int = 255, min_data_in_bin: int = 3,
+                   use_missing: bool = True, zero_as_missing: bool = False,
+                   forced_bounds: Optional[Sequence[float]] = None
+                   ) -> "BinMapper":
+        """Fit a NUMERICAL mapper from a sparse column: the explicit
+        nonzero sample values plus `num_rows - len(nz_values)` implicit
+        zeros, without ever materializing the dense column (the analog of
+        the reference binning CSC columns through their iterators,
+        src/io/dataset_loader.cpp:1080 + sparse_bin.hpp:74)."""
+        nz = np.asarray(nz_values, dtype=np.float64).reshape(-1)
+        na_mask = np.isnan(nz)
+        na_cnt = int(na_mask.sum())
+        nz = nz[~na_mask]
+        zero_cnt = int(num_rows) - len(nz) - na_cnt
+        self.is_categorical = False
+
+        if not use_missing:
+            self.missing_type = MISSING_NONE
+        elif zero_as_missing:
+            self.missing_type = MISSING_ZERO
+        elif na_cnt > 0:
+            self.missing_type = MISSING_NAN
+        else:
+            self.missing_type = MISSING_NONE
+
+        # zeros excluded from BOUNDS when zero_as_missing (they count as
+        # missing), but they still land in the default bin at transform
+        # time, so they must still feed the bin-occupancy stats
+        stats_zero_cnt = 0
+        if zero_as_missing:
+            small = np.abs(nz) <= K_ZERO_THRESHOLD
+            stats_zero_cnt = int(small.sum()) + zero_cnt  # explicit + implicit
+            nz = nz[~small]
+            zero_cnt = 0
+
+        distinct, counts = np.unique(nz, return_counts=True)
+        if zero_cnt > 0:
+            at = int(np.searchsorted(distinct, 0.0))
+            if at < len(distinct) and distinct[at] == 0.0:
+                counts = counts.copy()
+                counts[at] += zero_cnt
+            else:
+                distinct = np.insert(distinct, at, 0.0)
+                counts = np.insert(counts, at, zero_cnt)
+
+        if distinct.size == 0:
+            self.bin_upper_bound = np.array([np.inf])
+            self.num_bins = 1 + (1 if self.missing_type == MISSING_NAN else 0)
+            self._finalize_from_distinct(distinct, counts, na_cnt,
+                                         stats_zero_cnt)
+            return self
+
+        self.min_value = float(distinct[0])
+        self.max_value = float(distinct[-1])
+        if forced_bounds is not None and len(forced_bounds) > 0:
+            inner = sorted(float(b) for b in forced_bounds
+                           if self.min_value < b < self.max_value)
+            bounds = inner + [np.inf]
+        else:
+            bounds = self._bounds_from_distinct(distinct, counts, max_bin,
+                                                min_data_in_bin)
+        self.bin_upper_bound = np.asarray(bounds, dtype=np.float64)
+        self.num_bins = len(bounds)
+        if self.missing_type == MISSING_NAN:
+            self.num_bins += 1
+        self._finalize_from_distinct(distinct, counts, na_cnt,
+                                     stats_zero_cnt)
+        return self
+
+    def _finalize_from_distinct(self, distinct: np.ndarray,
+                                counts: np.ndarray, na_cnt: int,
+                                zero_as_missing_cnt: int = 0) -> None:
+        """default/most-frequent bin + triviality from distinct+counts —
+        the sparse twin of _finalize_numerical. `zero_as_missing_cnt`
+        holds zeros excluded from the bounds (zero_as_missing mode);
+        like the dense path's transform they still occupy the default
+        bin for occupancy stats."""
+        self.default_bin = int(np.searchsorted(self.bin_upper_bound, 0.0,
+                                               side="left"))
+        bc = np.zeros(self.num_bins, np.int64)
+        if distinct.size:
+            dbins = self.transform(distinct)
+            np.add.at(bc, dbins, counts.astype(np.int64))
+        if na_cnt and self.missing_type == MISSING_NAN:
+            bc[self.num_bins - 1] += na_cnt
+        elif na_cnt:
+            bc[self.default_bin] += na_cnt
+        bc[self.default_bin] += zero_as_missing_cnt
+        self.most_freq_bin = int(bc.argmax()) if bc.size else 0
+        self.is_trivial = int((bc > 0).sum()) <= 1
 
     def _finalize_numerical(self, values: np.ndarray, na_cnt: int) -> None:
         self.default_bin = int(np.searchsorted(self.bin_upper_bound, 0.0,

@@ -803,10 +803,27 @@ class GBDT:
         self._valid_bins.append(valid_set.device_bins())
         self._fused = None  # fused program must include the new valid set
 
+    def _valid_raw(self, i: int) -> np.ndarray:
+        """Valid set i's raw features as a DENSE array — the host tree
+        paths (renewing objectives, DART normalize, rollback) index raw
+        values row-wise every iteration, so a sparse valid set is
+        densified once and cached rather than per iteration."""
+        raw = self._valid_sets[i][1]
+        from .dataset import is_sparse
+        if is_sparse(raw):
+            cache = getattr(self, "_valid_dense", None)
+            if cache is None:
+                cache = self._valid_dense = {}
+            if i not in cache:
+                cache[i] = np.asarray(raw.toarray(), np.float64)
+            return cache[i]
+        return raw
+
     def _update_valid_scores(self, tree: Tree, class_id: int) -> None:
         for i, (vs, raw) in enumerate(self._valid_sets):
             self._valid_scores[i] = self._valid_scores[i].at[class_id].add(
-                jnp.asarray(tree.predict(raw).astype(np.float32)))
+                jnp.asarray(tree.predict(self._valid_raw(i))
+                            .astype(np.float32)))
 
     def valid_raw_scores(self, idx: int) -> np.ndarray:
         return np.asarray(self._valid_scores[idx]).T
@@ -853,13 +870,12 @@ class GBDT:
                     off += init.reshape(1, -1).astype(np.float32)
             return off
 
-        raw = self.predict_raw(np.asarray(self.train_set.raw_data,
-                                          np.float64))  # [N, K]
+        raw = self.predict_raw(self.train_set.raw_data)  # [N, K]
         self.scores = jnp.asarray(
             raw.T.astype(np.float32) + _dataset_init_offset(
                 self.train_set.metadata.init_score, self.num_data))
         for i, (vs, raw_v) in enumerate(self._valid_sets):
-            vraw = self.predict_raw(np.asarray(raw_v, np.float64))
+            vraw = self.predict_raw(raw_v)  # handles sparse + dense
             self._valid_scores[i] = jnp.asarray(
                 vraw.T.astype(np.float32) + _dataset_init_offset(
                     vs.metadata.init_score, vs.num_data))
@@ -887,7 +903,8 @@ class GBDT:
         for i, (vs, raw) in enumerate(self._valid_sets):
             for k, tree in enumerate(trees):
                 self._valid_scores[i] = self._valid_scores[i].at[k].add(
-                    jnp.asarray(-tree.predict(raw).astype(np.float32)))
+                    jnp.asarray(-tree.predict(self._valid_raw(i))
+                                .astype(np.float32)))
         self.iter -= 1
 
     def _predict_leaf_binned_train(self, tree: Tree):
@@ -955,6 +972,14 @@ class GBDT:
 
     def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
                     num_iteration: int = -1) -> np.ndarray:
+        from .dataset import is_sparse, sparse_row_batches
+        if is_sparse(data):
+            if data.shape[0] == 0:
+                data = np.zeros(data.shape)
+            else:
+                return np.concatenate(
+                    [self.predict_raw(b, start_iteration, num_iteration)
+                     for b in sparse_row_batches(data)], axis=0)
         data = np.asarray(data, np.float64)
         end = len(self.models) if num_iteration < 0 else \
             min(len(self.models), start_iteration + num_iteration)
@@ -1132,7 +1157,8 @@ class DART(GBDT):
         for i, (vs, raw) in enumerate(self._valid_sets):
             for k, tree in enumerate(trees):
                 self._valid_scores[i] = self._valid_scores[i].at[k].add(
-                    jnp.asarray(sign * tree.predict(raw).astype(np.float32)))
+                    jnp.asarray(sign * tree.predict(self._valid_raw(i))
+                                .astype(np.float32)))
 
     def _select_drop(self) -> List[int]:
         """Select iterations to drop (ref: dart.hpp:98 DroppingTrees).
@@ -1191,7 +1217,7 @@ class DART(GBDT):
                 (tree.leaf_value * delta).astype(np.float32))[leaves])
             for i, (vs, raw) in enumerate(self._valid_sets):
                 self._valid_scores[i] = self._valid_scores[i].at[k].add(
-                    jnp.asarray((tree.predict(raw) * delta)
+                    jnp.asarray((tree.predict(self._valid_raw(i)) * delta)
                                 .astype(np.float32)))
             tree.apply_shrinkage(new_factor)
         # scale the dropped trees + their drop weights

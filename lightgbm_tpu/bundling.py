@@ -71,8 +71,26 @@ def find_bundles(nonzero_masks: np.ndarray, num_bins: np.ndarray,
     singleton bundles — stored verbatim.
     """
     f, s = nonzero_masks.shape
-    max_conflicts = int(max_conflict_rate * s)
-    order = np.argsort(-nonzero_masks.sum(axis=1, dtype=np.int64))
+    nz_rows = [np.flatnonzero(nonzero_masks[i]) for i in range(f)]
+    return find_bundles_sparse(nz_rows, s, num_bins,
+                               max_conflict_rate=max_conflict_rate,
+                               max_bundle_bins=max_bundle_bins,
+                               bundleable=bundleable)
+
+
+def find_bundles_sparse(nz_rows: List[np.ndarray], sample_cnt: int,
+                        num_bins: np.ndarray,
+                        *, max_conflict_rate: float = 0.0,
+                        max_bundle_bins: int = 256,
+                        bundleable: Optional[np.ndarray] = None
+                        ) -> List[List[int]]:
+    """Greedy grouping from per-feature non-default sample row INDICES —
+    the core shared with the dense path and the entry point for sparse
+    (CSC) ingestion, where a dense [F, S] mask would defeat the point.
+    Bundle masks stay dense [S] bool (few bundles); each feature costs
+    O(nnz_f) to test and place."""
+    max_conflicts = int(max_conflict_rate * sample_cnt)
+    order = np.argsort(-np.array([len(r) for r in nz_rows], np.int64))
     # cap the per-feature candidate search like the reference's
     # max_search_group (ref: dataset.cpp:118 FindGroups) — without it,
     # wide data where most features conflict degrades quadratically
@@ -80,12 +98,13 @@ def find_bundles(nonzero_masks: np.ndarray, num_bins: np.ndarray,
     search_rng = np.random.RandomState(3)
 
     bundle_members: List[List[int]] = []
-    bundle_masks: List[np.ndarray] = []
+    bundle_masks: List[Optional[np.ndarray]] = []
     bundle_conflicts: List[int] = []
     bundle_bins: List[int] = []
     for feat in order:
         feat = int(feat)
         width = int(num_bins[feat]) - 1  # non-default bins it adds
+        rows = nz_rows[feat]
         placed = False
         if bundleable is None or bundleable[feat]:
             n_groups = len(bundle_members)
@@ -99,19 +118,22 @@ def find_bundles(nonzero_masks: np.ndarray, num_bins: np.ndarray,
                     continue
                 if bundle_bins[g] + width + 1 > max_bundle_bins:
                     continue
-                conflicts = int(np.sum(bundle_masks[g] & nonzero_masks[feat]))
+                conflicts = int(bundle_masks[g][rows].sum())
                 if bundle_conflicts[g] + conflicts <= max_conflicts:
                     bundle_members[g].append(feat)
-                    bundle_masks[g] = bundle_masks[g] | nonzero_masks[feat]
+                    bundle_masks[g][rows] = True
                     bundle_conflicts[g] += conflicts
                     bundle_bins[g] += width
                     placed = True
                     break
         if not placed:
             bundle_members.append([feat])
-            bundle_masks.append(
-                nonzero_masks[feat].copy()
-                if (bundleable is None or bundleable[feat]) else None)
+            if bundleable is None or bundleable[feat]:
+                mask = np.zeros(sample_cnt, bool)
+                mask[rows] = True
+                bundle_masks.append(mask)
+            else:
+                bundle_masks.append(None)
             bundle_conflicts.append(0)
             bundle_bins.append(width + 1)
     return bundle_members
@@ -136,6 +158,64 @@ def build_bundled_matrix(bins_fm: np.ndarray, num_bins: np.ndarray,
             fb = bins_fm[feat].astype(np.int64)
             nz = fb > 0
             col[nz] = info.offset_of[feat] + fb[nz] - 1
+        out[g] = col.astype(dtype)
+    return out, info
+
+
+def build_bundled_from_csc(csc, mappers, used: List[int],
+                           bundles: List[List[int]],
+                           num_bins: np.ndarray
+                           ) -> Tuple[np.ndarray, BundleInfo]:
+    """Build the stored [G, N] bundle matrix DIRECTLY from a scipy CSC
+    matrix — no dense [N, F] or [F, N] intermediate ever exists (the
+    point of the sparse ingestion path; ref: sparse_bin.hpp:74 and
+    LGBM_DatasetCreateFromCSC c_api.cpp:1330).
+
+    `used[j]` is the raw CSC column of logical feature j; `bundles`
+    holds logical feature indices. Encoding identical to
+    build_bundled_matrix: member f's non-default bins live at
+    [offset_f, offset_f + nb_f - 1); non-bundleable singletons are
+    stored verbatim (their implicit zeros at their default bin).
+    """
+    n = csc.shape[0]
+    info = BundleInfo.from_bundles(bundles, num_bins)
+    dtype = np.uint8 if info.num_bundle_bins <= 256 else np.uint16
+    out = np.zeros((len(bundles), n), dtype)
+    col = np.empty(n, np.int64)
+    for g, members in enumerate(bundles):
+        col[:] = 0
+        for feat in members:
+            m = mappers[feat]
+            # the bin an IMPLICIT zero lands in — transform(0.0), NOT
+            # m.default_bin: for categorical mappers category 0's bin is
+            # >= 1 while default_bin is always 0
+            zb = int(m.transform(np.zeros(1))[0])
+            sl = slice(csc.indptr[used[feat]], csc.indptr[used[feat] + 1])
+            rows = csc.indices[sl]
+            fb = m.transform(csc.data[sl]).astype(np.int64)
+            if len(members) == 1 and zb != 0:
+                # verbatim singleton: implicit zeros sit at zero's bin
+                col[:] = zb
+                col[rows] = fb
+            elif zb != 0:
+                # shared-bundle member whose implicit zeros are a REAL
+                # bin (a categorical with category 0 — dense-made
+                # bundles can contain these): zeros must be encoded,
+                # exactly like the dense builder encodes every fb > 0
+                # row. Write the complement first so explicit rows (and
+                # later members, last-wins like the reference's push
+                # order) overwrite it.
+                mask = np.ones(n, bool)
+                mask[rows] = False
+                col[mask] = info.offset_of[feat] + zb - 1
+                nz = fb > 0
+                col[rows[nz]] = info.offset_of[feat] + fb[nz] - 1
+                col[rows[~nz]] = 0
+            else:
+                # sparse-made bundles guarantee zb == 0 for shared
+                # members, so implicit zeros stay at stored 0
+                nz = fb > 0
+                col[rows[nz]] = info.offset_of[feat] + fb[nz] - 1
         out[g] = col.astype(dtype)
     return out, info
 

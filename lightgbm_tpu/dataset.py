@@ -19,6 +19,27 @@ from .binning import BinMapper
 from .config import Config
 
 
+def is_sparse(data) -> bool:
+    """True for any scipy sparse matrix (scipy optional: dense-only
+    installs never import it)."""
+    try:
+        import scipy.sparse as sp
+        return sp.issparse(data)
+    except ImportError:
+        return False
+
+
+def sparse_row_batches(data, budget_cells: int = 1 << 25):
+    """Yield dense float64 row batches of a scipy sparse matrix, sized
+    so each batch stays under ~budget_cells values — the single batching
+    policy shared by every sparse prediction path (ref: c_api.cpp
+    LGBM_BoosterPredictForCSR row-chunking)."""
+    csr = data.tocsr()
+    batch = max(1024, budget_cells // max(csr.shape[1], 1))
+    for i in range(0, csr.shape[0], batch):
+        yield np.asarray(csr[i:i + batch].toarray(), np.float64)
+
+
 def _transform_all(data: np.ndarray, mappers: List[BinMapper],
                    used: Sequence[int], dtype) -> np.ndarray:
     """Bin all used columns -> [F_used, N]. Uses the native threaded
@@ -232,6 +253,138 @@ class BinnedDataset:
         ds.raw_data = data
         if config.enable_bundle and len(mappers) > 1:
             ds._try_bundle(config)
+        return ds
+
+    @classmethod
+    def from_sparse(cls, data, config: Config,
+                    metadata: Optional[Metadata] = None,
+                    categorical_features: Sequence[int] = (),
+                    feature_names: Optional[List[str]] = None,
+                    reference: Optional["BinnedDataset"] = None,
+                    forced_bins: Optional[Dict[int, List[float]]] = None,
+                    ) -> "BinnedDataset":
+        """Bin a scipy CSR/CSC matrix WITHOUT densifying it (ref:
+        LGBM_DatasetCreateFromCSR/CSC c_api.cpp:1311,1330 feeding
+        SparseBin sparse_bin.hpp:74). Binning runs per CSC column on the
+        explicit nonzeros + the implicit zero count; storage is emitted
+        directly as the bundled [G, N] EFB matrix, so a 1M x 10k one-hot
+        matrix ingests in O(nnz + G*N) host memory, never O(N*F)."""
+        import scipy.sparse as sp
+        from .bundling import build_bundled_from_csc, find_bundles_sparse
+        if not sp.issparse(data):
+            raise ValueError("from_sparse expects a scipy sparse matrix")
+        if getattr(config, "linear_tree", False):
+            raise ValueError(
+                "linear_tree requires raw feature values; sparse input "
+                "is not supported for linear trees")
+        csc = data.tocsc()
+        csc.sort_indices()
+        n, f = csc.shape
+        metadata = metadata or Metadata(n)
+
+        if reference is not None:
+            # valid set aligned with the (sparse-trained) train set
+            mappers = reference.mappers
+            used = reference.used_features
+            nb = np.array([m.num_bins for m in mappers], np.int64)
+            if reference.bundle_info is not None:
+                bundles = [list(b) for b in reference.bundle_info.bundles]
+            else:
+                bundles = [[j] for j in range(len(mappers))]
+            bins_fm, info = build_bundled_from_csc(csc, mappers, used,
+                                                   bundles, nb)
+            ds = cls(bins_fm, mappers, used, reference.num_total_features,
+                     metadata, reference.feature_names)
+            # mirror the reference dataset's storage layout exactly
+            ds.bundle_info = (info if reference.bundle_info is not None
+                              else None)
+            return ds
+
+        # --- sample rows for binning (ref: bin_construct_sample_cnt) ---
+        sample_cnt = min(n, int(config.bin_construct_sample_cnt))
+        if sample_cnt < n:
+            rng = np.random.RandomState(config.data_random_seed)
+            rows = np.sort(rng.choice(n, sample_cnt, replace=False))
+            sample_csc = csc[rows, :].tocsc()
+            sample_csc.sort_indices()
+        else:
+            sample_csc = csc
+
+        cat_set = set(int(c) for c in categorical_features)
+        max_bin_by_feature = config.max_bin_by_feature
+        mappers_all: List[BinMapper] = []
+        for col in range(f):
+            mb = int(config.max_bin)
+            if max_bin_by_feature is not None and len(max_bin_by_feature) == f:
+                mb = int(max_bin_by_feature[col])
+            forced = forced_bins.get(col) if forced_bins else None
+            sl = slice(sample_csc.indptr[col], sample_csc.indptr[col + 1])
+            nz_vals = np.asarray(sample_csc.data[sl], np.float64)
+            m = BinMapper()
+            if col in cat_set:
+                # categorical needs exact per-category counts incl. the
+                # implicit zero category: materialize ONE sampled column
+                dense_col = np.zeros(sample_cnt)
+                dense_col[sample_csc.indices[sl]] = nz_vals
+                m.fit(dense_col, max_bin=mb,
+                      min_data_in_bin=int(config.min_data_in_bin),
+                      use_missing=bool(config.use_missing),
+                      zero_as_missing=bool(config.zero_as_missing),
+                      is_categorical=True)
+            else:
+                m.fit_sparse(nz_vals, sample_cnt, max_bin=mb,
+                             min_data_in_bin=int(config.min_data_in_bin),
+                             use_missing=bool(config.use_missing),
+                             zero_as_missing=bool(config.zero_as_missing),
+                             forced_bounds=forced)
+            mappers_all.append(m)
+
+        used = [i for i, m in enumerate(mappers_all)
+                if not (config.feature_pre_filter and m.is_trivial)]
+        if not used:
+            used = [0] if f else []
+        mappers = [mappers_all[i] for i in used]
+        nb = np.array([m.num_bins for m in mappers], np.int64)
+
+        # --- bundle structure from the SAMPLE's non-default rows ---
+        # zero_bins[j] = the bin an implicit zero lands in (transform(0));
+        # equals default_bin for numerical mappers but NOT for
+        # categorical ones (category 0's bin vs the 'other' bin 0)
+        zero_bins = np.array(
+            [int(m.transform(np.zeros(1))[0]) for m in mappers], np.int64)
+        nz_rows: List[np.ndarray] = []
+        for j, col in enumerate(used):
+            sl = slice(sample_csc.indptr[col], sample_csc.indptr[col + 1])
+            fb = mappers[j].transform(
+                np.asarray(sample_csc.data[sl], np.float64))
+            nz_rows.append(sample_csc.indices[sl][fb != zero_bins[j]])
+        max_bins = int(nb.max()) if len(nb) else 1
+        # same learner guard as _try_bundle: the parallel growers index
+        # LOGICAL [F, N] storage and have no bundle decode
+        if (config.enable_bundle and len(mappers) > 1
+                and config.tree_learner in ("serial",)):
+            bundles = find_bundles_sparse(
+                nz_rows, sample_cnt, nb,
+                max_conflict_rate=float(config.max_conflict_rate),
+                max_bundle_bins=max(max_bins, 256),
+                bundleable=(zero_bins == 0))
+        else:
+            bundles = [[j] for j in range(len(mappers))]
+
+        if len(bundles) == len(mappers):
+            # nothing bundled: emit the plain [F, N] layout in FEATURE
+            # order (find_bundles returns nnz-descending order) and skip
+            # the bundle decode indirection entirely
+            bundles = [[j] for j in range(len(mappers))]
+        bins_fm, info = build_bundled_from_csc(csc, mappers, used,
+                                               bundles, nb)
+        ds = cls(bins_fm, mappers, used, f, metadata, feature_names)
+        if len(bundles) < len(mappers):
+            ds.bundle_info = info
+        # the sparse matrix itself serves as raw_data: prediction paths
+        # densify in batches, continued training fast-forwards through
+        # predict_raw (linear trees are rejected above)
+        ds.raw_data = csc.tocsr()
         return ds
 
     def _try_bundle(self, config: Config) -> None:

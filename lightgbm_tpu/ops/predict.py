@@ -186,6 +186,9 @@ def predict_raw_cached(owner, trees: List, num_tree_per_iteration: int,
         owner._packed = pack_ensemble(trees, num_tree_per_iteration)
         owner._packed_key = cache_key
     n = data.shape[0]
+    k = max(owner._packed.num_trees_per_class, 1)
+    if n == 0:
+        return np.zeros((0, k))
     outs = []
     for lo in range(0, n, chunk):
         x = jnp.asarray(data[lo:lo + chunk], jnp.float32)

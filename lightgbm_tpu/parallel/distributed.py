@@ -176,6 +176,12 @@ def sync_dataset(dataset) -> None:
             raise ValueError(
                 "multi-host bin sync needs raw feature values on every "
                 "process (in-memory datasets only for now)")
+        from ..dataset import is_sparse
+        if is_sparse(raw):
+            raise ValueError(
+                "multi-host bin sync does not support sparse matrices "
+                "yet; densify the per-rank partition or pre-bin with a "
+                "shared reference dataset")
         binned.mappers = [_mapper_from_state(s) for s in blob["mappers"]]
         binned.used_features = list(blob["used_features"])
         binned.bins_fm = _transform_all(

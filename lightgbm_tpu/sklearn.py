@@ -15,6 +15,24 @@ from .basic import Booster, Dataset, LightGBMError
 from .engine import train as train_fn
 
 
+def _same_data(a, b) -> bool:
+    """Is the eval-set matrix the training matrix (so its Dataset can be
+    reused)? Sparse matrices compare by identity only."""
+    if a is b:
+        return True
+    try:
+        import scipy.sparse as sp
+        if sp.issparse(a) or sp.issparse(b):
+            return False
+    except ImportError:
+        pass
+    a, b = np.asarray(a), np.asarray(b)
+    return bool(np.shares_memory(a, b) or
+                (a.shape == b.shape and
+                 np.array_equal(a.astype(np.float64),
+                                b.astype(np.float64))))
+
+
 class LGBMModel:
     """Base estimator (ref: sklearn.py:535)."""
 
@@ -147,10 +165,7 @@ class LGBMModel:
                 vg = eval_group[i] if eval_group is not None else None
                 vi = (eval_init_score[i]
                       if eval_init_score is not None else None)
-                if np.shares_memory(np.asarray(vx), np.asarray(X)) or \
-                        (np.asarray(vx).shape == np.asarray(X).shape and
-                         np.array_equal(np.asarray(vx, np.float64),
-                                        np.asarray(X, np.float64))):
+                if _same_data(vx, X):
                     valid_sets.append(train_set)
                 else:
                     valid_sets.append(Dataset(
@@ -164,7 +179,8 @@ class LGBMModel:
                                  valid_sets=valid_sets,
                                  valid_names=valid_names,
                                  callbacks=callbacks)
-        self._n_features = np.asarray(X).shape[1]
+        self._n_features = (X.shape[1] if hasattr(X, "shape")
+                            else np.asarray(X).shape[1])
         self.fitted_ = True
         return self
 

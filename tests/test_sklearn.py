@@ -1,0 +1,233 @@
+"""scikit-learn estimator API tests.
+
+(ref: python-package/lightgbm/sklearn.py:535 LGBMModel and
+tests/python_package_test/test_sklearn.py — fit/predict semantics,
+classes_ mapping, params round-trip, early stopping, ranker groups.)
+"""
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.sklearn import (LGBMClassifier, LGBMModel, LGBMRanker,
+                                  LGBMRegressor)
+
+from conftest import make_binary, make_regression
+
+
+def _make_multiclass(n=800, f=8, k=3, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = (np.abs(X[:, 0]) + X[:, 1] + 0.3 * r.randn(n))
+    y = np.digitize(y, np.quantile(y, np.linspace(0, 1, k + 1)[1:-1]))
+    return X, y.astype(np.int64)
+
+
+# -- regressor ---------------------------------------------------------
+
+def test_regressor_fit_predict():
+    X, y = make_regression(800)
+    m = LGBMRegressor(n_estimators=20, num_leaves=15)
+    m.fit(X, y)
+    pred = m.predict(X)
+    assert pred.shape == (800,)
+    assert m.score(X, y) > 0.7
+
+
+def test_regressor_objective_l1():
+    X, y = make_regression(500)
+    m = LGBMRegressor(n_estimators=10, objective="regression_l1")
+    m.fit(X, y)
+    assert np.isfinite(m.predict(X)).all()
+
+
+def test_regressor_sparse_input():
+    sp = pytest.importorskip("scipy.sparse")
+    X, y = make_regression(500)
+    X[np.abs(X) < 0.8] = 0.0
+    m = LGBMRegressor(n_estimators=10, num_leaves=7)
+    m.fit(sp.csr_matrix(X), y)
+    assert m.n_features_ == X.shape[1]
+    np.testing.assert_allclose(m.predict(sp.csr_matrix(X)), m.predict(X),
+                               rtol=1e-6, atol=1e-9)
+
+
+# -- classifier --------------------------------------------------------
+
+def test_classifier_binary():
+    X, y = make_binary(800)
+    m = LGBMClassifier(n_estimators=20, num_leaves=15)
+    m.fit(X, y)
+    assert m.n_classes_ == 2
+    assert set(m.predict(X)) <= set(m.classes_)
+    proba = m.predict_proba(X)
+    assert proba.shape == (800, 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-6)
+    assert m.score(X, y) > 0.8
+
+
+def test_classifier_label_mapping():
+    """Non-contiguous labels must map back through classes_."""
+    X, y01 = make_binary(600)
+    y = np.where(y01 > 0, 7, 3)
+    m = LGBMClassifier(n_estimators=10)
+    m.fit(X, y)
+    np.testing.assert_array_equal(m.classes_, [3, 7])
+    assert set(m.predict(X)) <= {3, 7}
+    # proba column order follows classes_
+    proba = m.predict_proba(X)
+    acc = np.mean(np.where(proba[:, 1] > 0.5, 7, 3) == y)
+    assert acc > 0.8
+
+
+def test_classifier_multiclass():
+    X, y = _make_multiclass()
+    m = LGBMClassifier(n_estimators=15, num_leaves=15)
+    m.fit(X, y)
+    assert m.n_classes_ == 3
+    proba = m.predict_proba(X)
+    assert proba.shape == (800, 3)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    assert m.score(X, y) > 0.6
+
+
+def test_classifier_class_weight_balanced():
+    X, y = make_binary(800)
+    # unbalance the data
+    keep = np.concatenate([np.flatnonzero(y == 0)[:50],
+                           np.flatnonzero(y == 1)])
+    Xu, yu = X[keep], y[keep]
+    m = LGBMClassifier(n_estimators=10, class_weight="balanced")
+    m.fit(Xu, yu)
+    # balanced weighting should not collapse to the majority class
+    assert 0 < np.mean(m.predict(Xu) == 0)
+
+
+def test_classifier_raw_score_and_leaf():
+    X, y = make_binary(400)
+    m = LGBMClassifier(n_estimators=5, num_leaves=7)
+    m.fit(X, y)
+    raw = m.predict(X, raw_score=True)
+    assert raw.dtype.kind == "f" and np.abs(raw).max() > 0
+    leaves = m.predict(X, pred_leaf=True)
+    assert leaves.shape == (400, 5)
+    assert leaves.dtype.kind == "i"
+
+
+# -- eval sets + early stopping ---------------------------------------
+
+def test_eval_set_early_stopping():
+    X, y = make_binary(1200)
+    Xt, Xv, yt, yv = X[:800], X[800:], y[:800], y[800:]
+    m = LGBMClassifier(n_estimators=200, num_leaves=31, learning_rate=0.3)
+    m.fit(Xt, yt, eval_set=[(Xv, yv)], eval_metric="binary_logloss",
+          callbacks=[lgb.early_stopping(5, verbose=False)])
+    assert 0 < m.best_iteration_ < 200
+    assert "valid_0" in m.best_score_
+    # predict honors best_iteration automatically
+    p_best = m.predict_proba(Xv)[:, 1]
+    p_all = m.booster_.predict(Xv, num_iteration=m.booster_.num_trees())
+    assert p_best.shape == p_all.shape
+
+
+def test_eval_set_reuses_train():
+    X, y = make_binary(500)
+    evals = {}
+    m = LGBMClassifier(n_estimators=8)
+    m.fit(X, y, eval_set=[(X, y)], eval_metric="auc",
+          callbacks=[lgb.record_evaluation(evals)])
+    (name,) = evals.keys()
+    assert len(evals[name]["auc"]) == 8
+
+
+# -- params round-trip -------------------------------------------------
+
+def test_get_set_params_roundtrip():
+    m = LGBMClassifier(n_estimators=42, num_leaves=9, my_custom=3)
+    p = m.get_params()
+    assert p["n_estimators"] == 42 and p["num_leaves"] == 9
+    assert p["my_custom"] == 3
+    m2 = LGBMClassifier()
+    m2.set_params(**p)
+    assert m2.get_params() == p
+
+
+def test_set_params_kwargs_bucket():
+    m = LGBMRegressor()
+    m.set_params(max_bin=127)
+    assert m.get_params()["max_bin"] == 127
+    X, y = make_regression(300)
+    m.set_params(n_estimators=5)
+    m.fit(X, y)
+    assert m.booster_.num_trees() == 5
+
+
+def test_clone_compatible():
+    try:
+        from sklearn.base import clone
+    except ImportError:
+        pytest.skip("sklearn not installed")
+    m = LGBMClassifier(n_estimators=7, num_leaves=5)
+    m2 = clone(m)
+    assert m2.get_params()["n_estimators"] == 7
+
+
+# -- introspection -----------------------------------------------------
+
+def test_feature_importances_and_names():
+    X, y = make_binary(500)
+    m = LGBMClassifier(n_estimators=10, importance_type="gain")
+    m.fit(X, y, feature_name=[f"f{i}" for i in range(X.shape[1])])
+    imp = m.feature_importances_
+    assert imp.shape == (X.shape[1],)
+    assert imp.sum() > 0
+    assert m.feature_name_ == [f"f{i}" for i in range(X.shape[1])]
+
+
+def test_not_fitted_errors():
+    m = LGBMClassifier()
+    with pytest.raises(lgb.LightGBMError):
+        m.predict(np.zeros((2, 3)))
+    with pytest.raises(lgb.LightGBMError):
+        _ = m.feature_importances_
+
+
+# -- ranker ------------------------------------------------------------
+
+def test_ranker_fit_with_groups():
+    r = np.random.RandomState(0)
+    n_q, per_q = 40, 12
+    n = n_q * per_q
+    X = r.randn(n, 6)
+    rel = np.clip((X[:, 0] + 0.4 * r.randn(n)) * 1.2 + 1.5, 0, 4)
+    y = rel.astype(int)
+    group = np.full(n_q, per_q)
+    m = LGBMRanker(n_estimators=15, num_leaves=7,
+                   min_child_samples=5)
+    m.fit(X, y, group=group, eval_set=[(X, y)], eval_group=[group],
+          eval_metric="ndcg")
+    scores = m.predict(X)
+    assert scores.shape == (n,)
+    # ranking quality: top-scored docs in each query should have higher
+    # mean relevance than bottom-scored
+    tops, bots = [], []
+    for q in range(n_q):
+        s = scores[q * per_q:(q + 1) * per_q]
+        rq = y[q * per_q:(q + 1) * per_q]
+        order = np.argsort(-s)
+        tops.append(rq[order[:3]].mean())
+        bots.append(rq[order[-3:]].mean())
+    assert np.mean(tops) > np.mean(bots)
+
+
+def test_ranker_requires_group():
+    X, y = make_binary(100)
+    with pytest.raises(lgb.LightGBMError):
+        LGBMRanker().fit(X, y)
+
+
+def test_top_level_exports():
+    assert lgb.LGBMClassifier is LGBMClassifier
+    assert lgb.LGBMRegressor is LGBMRegressor
+    assert lgb.LGBMRanker is LGBMRanker
+    assert lgb.LGBMModel is LGBMModel
