@@ -139,6 +139,13 @@ class GBDT:
         self._static = dict(
             num_leaves=int(config.num_leaves),
             max_bins=int(train_set.max_bins),
+            # intermediate/advanced monotone methods: exact pairwise
+            # leaf-box bounds (split.compute_box_bounds) replace the
+            # basic midpoint propagation
+            mono_pairwise=bool(
+                np.any(mono != 0)
+                and str(config.monotone_constraints_method)
+                in ("intermediate", "advanced")),
         )
         self._forced = self._parse_forced_splits()
         self._interaction_groups = self._parse_interaction_constraints()

@@ -513,15 +513,6 @@ class Config:
             if key in new_keys and key not in _WARNED_UNSUPPORTED:
                 _WARNED_UNSUPPORTED.add(key)
                 log.warning(f"{key} has no effect: {msg}")
-        if "monotone_constraints_method" in new_keys and \
-                str(self.monotone_constraints_method) in (
-                    "intermediate", "advanced") and \
-                "monotone_constraints_method" not in _WARNED_UNSUPPORTED:
-            _WARNED_UNSUPPORTED.add("monotone_constraints_method")
-            log.warning(
-                "monotone_constraints_method="
-                f"{self.monotone_constraints_method} is not implemented; "
-                "falling back to 'basic' bound propagation")
 
     def _post_process(self) -> None:
         self.objective = _OBJECTIVE_ALIASES.get(str(self.objective).lower(), self.objective)

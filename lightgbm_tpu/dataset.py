@@ -35,7 +35,7 @@ def sparse_row_batches(data, budget_cells: int = 1 << 25):
     policy shared by every sparse prediction path (ref: c_api.cpp
     LGBM_BoosterPredictForCSR row-chunking)."""
     csr = data.tocsr()
-    batch = max(1024, budget_cells // max(csr.shape[1], 1))
+    batch = max(1, budget_cells // max(csr.shape[1], 1))
     for i in range(0, csr.shape[0], batch):
         yield np.asarray(csr[i:i + batch].toarray(), np.float64)
 
@@ -298,6 +298,7 @@ class BinnedDataset:
             # mirror the reference dataset's storage layout exactly
             ds.bundle_info = (info if reference.bundle_info is not None
                               else None)
+            ds.raw_data = csc.tocsr()
             return ds
 
         # --- sample rows for binning (ref: bin_construct_sample_cnt) ---

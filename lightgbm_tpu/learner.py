@@ -94,6 +94,9 @@ class _GrowState(NamedTuple):
     leaves: _LeafSplits
     used_features: Optional[jax.Array]  # [L, F] bool (interaction constraints)
     n_applied: jax.Array  # scalar int32: applied-split counter (leaf ids)
+    # leaf feature-range boxes [L, F] int32 (pairwise monotone modes only)
+    box_lo: Optional[jax.Array] = None
+    box_hi: Optional[jax.Array] = None
 
 
 def _store_split(leaves: _LeafSplits, idx, info: SplitInfo, depth, output,
@@ -187,8 +190,13 @@ def grow_tree(bins_fm: jax.Array,
               extra_trees: bool = False,
               ff_bynode: float = 1.0,
               bundle=None,
-              num_bundle_bins: int = 0):
+              num_bundle_bins: int = 0,
+              mono_pairwise: bool = False):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
+
+    mono_pairwise: use the exact pairwise leaf-box monotone bounds
+    (monotone_constraints_method intermediate/advanced — see
+    split_ops.compute_box_bounds) instead of basic midpoint propagation.
 
     sample_mask: [N] float {0,1} bagging/GOSS selection (excluded rows still
     get a leaf assignment for score updates, but contribute no statistics —
@@ -275,6 +283,10 @@ def grow_tree(bins_fm: jax.Array,
         used_features=(jnp.zeros((L, num_features), bool)
                        if interaction_groups is not None else None),
         n_applied=jnp.int32(0),
+        box_lo=(jnp.zeros((L, num_features), jnp.int32)
+                if mono_pairwise else None),
+        box_hi=(jnp.full((L, num_features), max_bins - 1, jnp.int32)
+                if mono_pairwise else None),
     )
 
     if forced is None:
@@ -370,10 +382,34 @@ def grow_tree(bins_fm: jax.Array,
                           leaves.left_output[best_leaf])
         out_r = jnp.where(use_forced, f_out_r_c,
                           leaves.right_output[best_leaf])
-
-        l_min, l_max, r_min, r_max = split_ops.propagate_monotone_bounds(
-            out_l, out_r, meta.monotone[feat].astype(jnp.int32),
-            meta.is_categorical[feat], p_minb, p_maxb)
+        if mono_pairwise:
+            # pairwise modes tighten bounds after OTHER leaves split, so
+            # stored candidate outputs must be re-clipped to the leaf's
+            # CURRENT bounds (the reference instead recomputes affected
+            # leaves' best splits, hpp:52 RecomputeConstraintsIfNeeded)
+            out_l = jnp.clip(out_l, p_minb, p_maxb)
+            out_r = jnp.clip(out_r, p_minb, p_maxb)
+            box_lo, box_hi = split_ops.split_child_boxes(
+                state.box_lo, state.box_hi, best_leaf, new_leaf, feat, thr,
+                meta.is_categorical[feat], valid)
+            out_now = leaves.output.at[best_leaf].set(
+                jnp.where(valid, out_l, parent_out))
+            out_now = out_now.at[new_leaf].set(
+                jnp.where(valid, out_r, out_now[jnp.minimum(new_leaf, L - 1)]))
+            leaf_in_use = jnp.arange(L, dtype=jnp.int32) <= n_applied
+            minb_all, maxb_all = split_ops.compute_box_bounds(
+                box_lo, box_hi, out_now, leaf_in_use, meta.monotone)
+            leaves = leaves._replace(
+                min_bound=jnp.where(valid, minb_all, leaves.min_bound),
+                max_bound=jnp.where(valid, maxb_all, leaves.max_bound))
+            l_min, l_max = minb_all[best_leaf], maxb_all[best_leaf]
+            ni = jnp.minimum(new_leaf, L - 1)
+            r_min, r_max = minb_all[ni], maxb_all[ni]
+        else:
+            box_lo, box_hi = state.box_lo, state.box_hi
+            l_min, l_max, r_min, r_max = split_ops.propagate_monotone_bounds(
+                out_l, out_r, meta.monotone[feat].astype(jnp.int32),
+                meta.is_categorical[feat], p_minb, p_maxb)
 
         # --- per-child allowed features (interaction constraints)
         used_features = state.used_features
@@ -428,7 +464,8 @@ def grow_tree(bins_fm: jax.Array,
             internal_weight=ph,
             internal_count=pc,
         )
-        return (_GrowState(row_leaf, pool, leaves, used_features, n_applied),
+        return (_GrowState(row_leaf, pool, leaves, used_features, n_applied,
+                           box_lo, box_hi),
                 dict(record=record, valid=valid))
 
     # unroll=2: a single-step scan body wrapping pallas_call lowers to a
@@ -512,7 +549,8 @@ def grow_tree_waved(bins_fm: jax.Array,
                     ff_bynode: float = 1.0,
                     quant: Optional[tuple] = None,
                     bundle=None,
-                    num_bundle_bins: int = 0):
+                    num_bundle_bins: int = 0,
+                    mono_pairwise: bool = False):
     """Leaf-wise growth with waved (batched) histogram construction.
 
     Identical split mathematics to `grow_tree`, but histogram builds are
@@ -657,7 +695,7 @@ def grow_tree_waved(bins_fm: jax.Array,
         later wave revives growth with fresh candidates, and gap-free
         ids are what Tree.from_arrays and the score updater index by.
         """
-        row_leaf, leaves, used, n_applied = carry
+        row_leaf, leaves, used, n_applied, box_lo, box_hi = carry
         best_leaf = jnp.argmax(leaves.gain).astype(jnp.int32)
         valid = leaves.gain[best_leaf] > 0.0
         # invalid steps use the out-of-bounds id L: every .at[] write to
@@ -688,9 +726,30 @@ def grow_tree_waved(bins_fm: jax.Array,
         out_r = leaves.right_output[best_leaf]
         chosen_gain = leaves.gain[best_leaf]
 
-        l_min, l_max, r_min, r_max = split_ops.propagate_monotone_bounds(
-            out_l, out_r, meta.monotone[feat].astype(jnp.int32),
-            meta.is_categorical[feat], p_minb, p_maxb)
+        if mono_pairwise:
+            # bounds may have tightened since this candidate was stored
+            out_l = jnp.clip(out_l, p_minb, p_maxb)
+            out_r = jnp.clip(out_r, p_minb, p_maxb)
+            box_lo, box_hi = split_ops.split_child_boxes(
+                box_lo, box_hi, best_leaf, new_leaf, feat, thr,
+                meta.is_categorical[feat], valid)
+            out_now = leaves.output.at[best_leaf].set(
+                jnp.where(valid, out_l, parent_out))
+            ni = jnp.minimum(new_leaf, L - 1)
+            out_now = out_now.at[new_leaf].set(
+                jnp.where(valid, out_r, out_now[ni]))
+            leaf_in_use = jnp.arange(L, dtype=jnp.int32) <= n_applied
+            minb_all, maxb_all = split_ops.compute_box_bounds(
+                box_lo, box_hi, out_now, leaf_in_use, meta.monotone)
+            leaves = leaves._replace(
+                min_bound=jnp.where(valid, minb_all, leaves.min_bound),
+                max_bound=jnp.where(valid, maxb_all, leaves.max_bound))
+            l_min, l_max = minb_all[best_leaf], maxb_all[best_leaf]
+            r_min, r_max = minb_all[ni], maxb_all[ni]
+        else:
+            l_min, l_max, r_min, r_max = split_ops.propagate_monotone_bounds(
+                out_l, out_r, meta.monotone[feat].astype(jnp.int32),
+                meta.is_categorical[feat], p_minb, p_maxb)
 
         if used is not None:
             child_used = used[best_leaf].at[feat].set(True)
@@ -722,7 +781,7 @@ def grow_tree_waved(bins_fm: jax.Array,
                   left_id=best_leaf, right_id=new_leaf,
                   small_id=jnp.where(left_smaller, best_leaf, new_leaf),
                   left_smaller=left_smaller)
-        return (row_leaf, leaves, used, n_applied), ys
+        return (row_leaf, leaves, used, n_applied, box_lo, box_hi), ys
 
     def child_candidates(hist, cid, fmask_c, salt, leaves):
         """find_best_split for one child from its stored stats."""
@@ -738,11 +797,18 @@ def grow_tree_waved(bins_fm: jax.Array,
     all_valid = []
     s0 = 0
     n_applied = jnp.int32(0)
+    wbox_lo = (jnp.zeros((L, num_features), jnp.int32)
+               if mono_pairwise else None)
+    wbox_hi = (jnp.full((L, num_features), max_bins - 1, jnp.int32)
+               if mono_pairwise else None)
     schedule = _wave_schedule(L, wave_max, SLOTS)
     for wi, W in enumerate(schedule):
-        (row_leaf, leaves, used_features, n_applied), ys = lax.scan(
-            wave_step, (row_leaf, leaves, used_features, n_applied),
-            jnp.arange(s0, s0 + W, dtype=jnp.int32))
+        (row_leaf, leaves, used_features, n_applied, wbox_lo, wbox_hi), \
+            ys = lax.scan(
+                wave_step,
+                (row_leaf, leaves, used_features, n_applied,
+                 wbox_lo, wbox_hi),
+                jnp.arange(s0, s0 + W, dtype=jnp.int32))
         all_records.append(ys["record"])
         all_valid.append(ys["valid"])
         s0 += W

@@ -18,6 +18,7 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .histogram import GRAD, HESS, COUNT
 
@@ -162,6 +163,82 @@ def propagate_monotone_bounds(out_l, out_r, mono_t, is_cat_split,
     r_min = jnp.where(upd & (mono_t > 0), jnp.maximum(p_minb, mid), p_minb)
     r_max = jnp.where(upd & (mono_t < 0), jnp.minimum(p_maxb, mid), p_maxb)
     return l_min, l_max, r_min, r_max
+
+
+def compute_box_bounds(box_lo, box_hi, outputs, leaf_valid, monotone):
+    """Exact pairwise leaf-output bounds for the `intermediate` and
+    `advanced` monotone methods — the TPU-native re-architecture of
+    IntermediateLeafConstraints / AdvancedLeafConstraints (ref:
+    monotone_constraints.hpp:517,859).
+
+    The reference refines its basic midpoint constraints by recursively
+    walking the tree (GoUpToFindLeavesToUpdate / GoDown…, hpp:625,707)
+    to find leaves whose feature ranges are contiguous to a changed
+    leaf, with per-threshold cumulative extremum arrays in the advanced
+    mode. Here the same information lives in flat per-leaf FEATURE-RANGE
+    BOXES, and the true constraint set is computed exactly in one
+    vectorized pass: monotonicity along feature f relates leaves a, b
+    iff their boxes overlap in every other feature and a's f-range lies
+    strictly below b's (leaf boxes partition the space, so overlapping
+    everywhere else forces disjoint f-ranges). `out_a <= out_b` over
+    exactly those pairs is the minimal sound constraint set — it
+    subsumes both reference methods (their ancestor-based sets are
+    supersets of these pairs), so one mechanism serves both modes.
+
+    box_lo/box_hi: [L, F] int32 inclusive bin ranges; outputs: [L];
+    leaf_valid: [L] bool (slots in use); monotone: [F] in {-1, 0, +1}.
+    Returns (min_bound, max_bound): [L] f32.
+    """
+    f32 = outputs.dtype
+    num_l, num_f = box_lo.shape
+
+    # Never materialize [L, L, F]: at F=10k (the wide-sparse regime)
+    # that is ~650M elements per scan step. Everything stays [L, L] via
+    # a rolled loop over features.
+    def _ov(f):
+        return ((box_lo[:, None, f] <= box_hi[None, :, f])
+                & (box_lo[None, :, f] <= box_hi[:, None, f]))
+
+    ov_cnt = lax.fori_loop(
+        0, num_f,
+        lambda f, acc: acc + _ov(f).astype(jnp.int32),
+        jnp.zeros((num_l, num_l), jnp.int32))
+
+    def _accum(f, p_rel):
+        # overlap in all features except f <=> ov_cnt - ov_f == F-1
+        rel = ((box_hi[:, None, f] < box_lo[None, :, f])
+               & ((ov_cnt - _ov(f).astype(jnp.int32)) == (num_f - 1)))
+        m = monotone[f]
+        return p_rel | (rel & (m > 0)) | (rel.T & (m < 0))
+
+    # P[a, b] = "out_a <= out_b required"
+    p_rel = lax.fori_loop(0, num_f, _accum,
+                          jnp.zeros((num_l, num_l), jnp.bool_))
+    p_rel = p_rel & leaf_valid[:, None] & leaf_valid[None, :]
+    inf = jnp.asarray(jnp.inf, f32)
+    max_bound = jnp.min(jnp.where(p_rel, outputs[None, :], inf), axis=1)
+    min_bound = jnp.max(jnp.where(p_rel, outputs[:, None], -inf), axis=0)
+    return min_bound, max_bound
+
+
+def split_child_boxes(box_lo, box_hi, leaf, new_leaf, feat, thr,
+                      is_cat_split, valid):
+    """Update leaf boxes after applying a split: left keeps `leaf`'s id
+    with f-range capped at thr, right (`new_leaf`) starts at thr+1.
+    Categorical splits leave both ranges untouched (no order semantics;
+    the reference likewise descends categorical children conservatively,
+    monotone_constraints.hpp:598-601)."""
+    p_lo, p_hi = box_lo[leaf], box_hi[leaf]
+    l_hi = jnp.where(is_cat_split, p_hi, p_hi.at[feat].set(
+        jnp.minimum(p_hi[feat], thr)))
+    r_lo = jnp.where(is_cat_split, p_lo, p_lo.at[feat].set(
+        jnp.maximum(p_lo[feat], thr + 1)))
+    box_lo = box_lo.at[new_leaf].set(jnp.where(valid, r_lo,
+                                               box_lo[new_leaf]))
+    box_hi = box_hi.at[leaf].set(jnp.where(valid, l_hi, box_hi[leaf]))
+    box_hi = box_hi.at[new_leaf].set(jnp.where(valid, p_hi,
+                                               box_hi[new_leaf]))
+    return box_lo, box_hi
 
 
 def _monotone_penalty_factor(depth, hp: SplitHyperParams):

@@ -34,6 +34,20 @@ from ..objectives import ObjectiveFunction
 from . import mesh as mesh_lib
 
 
+def _static_for_parallel(static: dict, learner: str) -> dict:
+    """The serial grower's static kwargs minus the ones the sharded
+    growers don't implement (pairwise monotone bounds fall back to
+    basic there, like the reference's parallel learners)."""
+    static = dict(static)
+    if static.pop("mono_pairwise", False):
+        import warnings
+        warnings.warn(
+            f"monotone_constraints_method intermediate/advanced is "
+            f"not supported by tree_learner={learner}; using the "
+            "basic method")
+    return static
+
+
 class _DataParallelMixin:
     """Shards row-indexed device state over the mesh data axis."""
 
@@ -153,9 +167,10 @@ class VotingParallelGBDT(_DataParallelMixin, GBDT):
             from .voting import make_sharded_voting_grow
             top_k = max(1, min(int(config.top_k),
                                self.train_set.num_features))
+            static = _static_for_parallel(self._static, "voting")
             grow = make_sharded_voting_grow(
                 self.mesh, top_k=top_k, hist_impl="xla",
-                has_categorical=self._has_categorical, **self._static)
+                has_categorical=self._has_categorical, **static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
                               forced=None, node_key=None):
@@ -196,9 +211,10 @@ class FeatureParallelGBDT(GBDT):
                 lambda a: mesh_lib.replicate(self.mesh, a),
                 self.feature_meta)
             from .feature_parallel import make_sharded_feature_grow
+            static = _static_for_parallel(self._static, "feature")
             grow = make_sharded_feature_grow(
                 self.mesh, hist_impl="xla",
-                has_categorical=self._has_categorical, **self._static)
+                has_categorical=self._has_categorical, **static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
                               forced=None, node_key=None):

@@ -52,6 +52,65 @@ def test_monotone_grid_deep_tree_conflicting_interactions():
         f"monotone violation {max_violation} on constrained model")
 
 
+def _monotone_sweep_violation(bst, rng, ncols, col=0, lo=-2, hi=2):
+    sweep = np.linspace(lo, hi, 41)
+    worst = 0.0
+    for row in rng.uniform(lo, hi, (50, ncols)):
+        grid = np.tile(row, (len(sweep), 1))
+        grid[:, col] = sweep
+        diffs = np.diff(bst.predict(grid))
+        if diffs.size:
+            worst = max(worst, float(-diffs.min()))
+    return worst
+
+
+def _monotone_fixture(seed=0, n=4000):
+    rng = np.random.RandomState(seed)
+    X = rng.uniform(-2, 2, (n, 4))
+    y = (X[:, 0] * X[:, 1] + 0.5 * X[:, 2]
+         + 0.2 * rng.randn(n)).astype(np.float32)
+    return X, y, rng
+
+
+def test_monotone_methods_grid():
+    """intermediate/advanced (exact pairwise leaf-box bounds, ref:
+    monotone_constraints.hpp:517,859) must stay strictly monotone on
+    both growers, like basic."""
+    X, y, rng = _monotone_fixture()
+    for method in ("intermediate", "advanced"):
+        for wave in (0, 42):
+            params = {"objective": "regression", "num_leaves": 31,
+                      "min_data_in_leaf": 5, "learning_rate": 0.2,
+                      "verbosity": -1, "tpu_wave_max": wave,
+                      "monotone_constraints": [1, 0, 0, 0],
+                      "monotone_constraints_method": method}
+            bst = _train(X, y, params, rounds=15)
+            v = _monotone_sweep_violation(bst, rng, 4)
+            assert v <= 1e-6, (method, wave, v)
+
+
+def test_monotone_intermediate_less_constraining_than_basic():
+    """The reference's selling point for intermediate/advanced: much
+    less constraining than basic, so the constrained fit recovers more
+    accuracy (ref: docs monotone_constraints_method). Train both and
+    compare training MSE."""
+    X, y, rng = _monotone_fixture(seed=3)
+    base = {"objective": "regression", "num_leaves": 63,
+            "min_data_in_leaf": 5, "learning_rate": 0.2,
+            "verbosity": -1, "tpu_wave_max": 0,
+            "monotone_constraints": [1, 0, 0, 0]}
+    mse = {}
+    for method in ("basic", "intermediate", "advanced"):
+        bst = _train(X, y, {**base,
+                            "monotone_constraints_method": method},
+                     rounds=30)
+        mse[method] = float(np.mean((bst.predict(X) - y) ** 2))
+        assert _monotone_sweep_violation(bst, rng, 4) <= 1e-6, method
+    # pairwise bounds must not fit WORSE than midpoint propagation
+    assert mse["intermediate"] <= mse["basic"] * 1.02, mse
+    assert mse["advanced"] <= mse["basic"] * 1.02, mse
+
+
 def test_monotone_decreasing_with_bagging_and_depth_cap():
     rng = np.random.RandomState(1)
     n = 3000
