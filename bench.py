@@ -98,9 +98,11 @@ def main():
     else:
         print("# axon relay not listening on 127.0.0.1:8082+; "
               "skipping TPU attempts", file=sys.stderr)
-    # CPU fallback: tiny shard so the 1-core host finishes. Clearly
-    # flagged via platform=cpu in the child's `unit` string.
-    attempts.append((min(requested, 100_000), "cpu", budget * 0.75))
+    # CPU fallback: tiny shard so the 1-core host finishes (measured:
+    # ~90s compile + ~11s/iter at 20k rows, 255 leaves — 100k rows blew
+    # the budget in round 4's relay outage). Clearly flagged via
+    # platform=cpu in the child's `unit` string.
+    attempts.append((min(requested, 50_000), "cpu", budget * 0.75))
 
     import tempfile
     queue = list(attempts)
@@ -143,9 +145,26 @@ def _measure():
     warmup = 2
 
     import jax
+    # persistent compilation cache: a retried/repeated bench attempt (or
+    # a later driver run in the same image) skips the multi-minute waved
+    # 255-leaf compile entirely
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without the cache knobs
     import lightgbm_tpu as lgb
 
     platform = jax.default_backend()
+    if platform == "cpu":
+        # the 1-core fallback host can't turn 10 measured iterations
+        # around inside the attempt budget; 1+3 iterations still give a
+        # valid per-iter number once compile is excluded
+        iters = min(iters, int(os.environ.get("BENCH_CPU_ITERS", 3)))
+        warmup = 1
     rng = np.random.RandomState(0)
     # Higgs-like: mix of informative and noise features, ~53% positive
     x = rng.randn(n, f).astype(np.float32)

@@ -52,6 +52,25 @@ def _tree_record_to_host(record) -> Dict[str, np.ndarray]:
     return {k: np.asarray(v) for k, v in record._asdict().items()}
 
 
+def _stack_class_records(recs):
+    """[K] per-class TreeArrays -> one TreeArrays with a leading class
+    axis (traced; used inside the fused programs)."""
+    if len(recs) == 1:
+        return jax.tree_util.tree_map(lambda x: x[None], recs[0])
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *recs)
+
+
+def _records_to_host(recs):
+    """List of per-iteration records -> host arrays with a leading
+    iteration axis, in ONE device->host transfer set (a single-element
+    list skips the device-side stack entirely)."""
+    if len(recs) == 1:
+        host = jax.device_get(recs[0])
+        return jax.tree_util.tree_map(lambda x: x[None], host)
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *recs)
+    return jax.device_get(stacked)
+
+
 class GBDT:
     """Gradient Boosted Decision Trees (ref: src/boosting/gbdt.h:38)."""
 
@@ -200,6 +219,7 @@ class GBDT:
         leaf_arr = np.full(L - 1, -1, np.int32)
         feat_arr = np.full(L - 1, -1, np.int32)
         thr_arr = np.full(L - 1, -1, np.int32)
+        cat_arr = np.zeros(L - 1, np.bool_)
         queue = [(0, spec)]
         s = 0
         while queue and s < L - 1:
@@ -208,18 +228,14 @@ class GBDT:
             if raw_f not in used_map:
                 continue  # feature dropped as trivial — skip this subtree
             j = used_map[raw_f]
-            if ts.mappers[j].is_categorical:
-                # categorical partitioning is bin == threshold, which the
-                # forced cumulative gather cannot express — skip with a
-                # warning rather than corrupt the split
-                import warnings
-                warnings.warn(
-                    f"forced split on categorical feature {raw_f} is not "
-                    "supported; skipping this forced subtree")
-                continue
+            # numerical: value -> upper-bound bin; categorical: the
+            # category's bin, split one-vs-rest (ref: ForceSplits
+            # serial_tree_learner.cpp:628 -> Dataset::BinThreshold; the
+            # forced categorical split is the single-category bitset)
             tbin = int(self.train_set.mappers[j].transform(
                 np.asarray([float(node["threshold"])]))[0])
             leaf_arr[s], feat_arr[s], thr_arr[s] = leaf, j, tbin
+            cat_arr[s] = ts.mappers[j].is_categorical
             if "left" in node and node["left"]:
                 queue.append((leaf, node["left"]))
             if "right" in node and node["right"]:
@@ -228,7 +244,7 @@ class GBDT:
         if s == 0:
             return None
         return (jnp.asarray(leaf_arr), jnp.asarray(feat_arr),
-                jnp.asarray(thr_arr))
+                jnp.asarray(thr_arr), jnp.asarray(cat_arr))
 
     def _parse_interaction_constraints(self):
         """interaction_constraints -> [G, F_used] bool array or None
@@ -304,9 +320,12 @@ class GBDT:
         self._host_models = value
 
     def _fast_path_ok(self, custom_grad) -> bool:
+        return self.boosting_type == "gbdt" and \
+            self._fast_path_core_ok(custom_grad)
+
+    def _fast_path_core_ok(self, custom_grad) -> bool:
+        """Conditions shared by the GBDT and DART fused paths."""
         if custom_grad is not None or self.objective is None:
-            return False
-        if self.boosting_type != "gbdt":
             return False
         if self._has_cegb_coupled:
             # coupled penalties change per iteration with the used-feature
@@ -315,10 +334,18 @@ class GBDT:
         if self.config.linear_tree:
             # per-leaf least-squares fits run on host
             return False
-        # objectives that renew leaf outputs need per-iteration host work
+        # objectives that renew leaf outputs stay fused when they provide
+        # the traced renewal (L1/Huber/Quantile/MAPE percentile renew);
+        # only custom objectives with host-only renewal fall back. The
+        # traced renewal accumulates weights in f32 (no x64 on TPU), so
+        # above 2^24 rows — where unit-weight cumsums stop being exactly
+        # representable — the f64 host renewal is used instead.
         renews = type(self.objective).renew_tree_output is not \
             ObjectiveFunction.renew_tree_output
-        return not renews
+        renews_traced = (type(self.objective).renew_leaves_traced is not
+                         ObjectiveFunction.renew_leaves_traced
+                         and self.num_data < (1 << 24))
+        return not renews or renews_traced
 
     def _grad_fn(self, scores):
         """Traced gradient computation [K, N] (ref: GBDT::Boosting)."""
@@ -422,13 +449,10 @@ class GBDT:
         return (self.objective.device_state()
                 if self.objective is not None else {"arrays": {}, "sub": {}})
 
-    def _make_fused(self):
-        """Build the one-XLA-program-per-iteration jit. All N-sized device
-        buffers (bin tensor, valid bins, objective label/weight/pad arrays)
-        are explicit arguments — closure capture would bake them into the
-        HLO as multi-hundred-MB literal constants and overflow compilation
-        at Higgs scale."""
-        grow = functools.partial(self._grow_fn(), **self._grow_kwargs(),
+    def _grow_partial(self):
+        """The grower with all static parameters bound (shared by the GBDT
+        and DART fused-program builders)."""
+        return functools.partial(self._grow_fn(), **self._grow_kwargs(),
                                  hist_dtype=jnp.float32,
                                  hist_impl=self._hist_impl,
                                  hist_precision=self.config.tpu_hist_precision,
@@ -437,7 +461,62 @@ class GBDT:
                                  extra_trees=bool(self.config.extra_trees),
                                  ff_bynode=float(
                                      self.config.feature_fraction_bynode))
-        goss = self.config.data_sample_strategy == "goss"
+
+    def _grow_class_traced(self, grow, bins_fm, k, key, grad, hess,
+                           sample_mask, scores_k, it):
+        """Traced growth of class k's tree for one iteration: GOSS,
+        gradient quantization, feature sampling, growth, leaf renewal.
+        Shared by the GBDT and DART fused programs. Returns
+        (rec, row_leaf)."""
+        mask = sample_mask
+        if self.config.data_sample_strategy == "goss":
+            mask, scale = self._goss_in_jit(
+                jax.random.fold_in(key, 100 + k), grad, hess)
+            grad, hess = grad * scale, hess * scale
+        true_grad, true_hess = grad, hess
+        quant = None
+        if self.config.use_quantized_grad:
+            grad, hess, quant = self._discretize_in_jit(
+                jax.random.fold_in(key, 300 + k), grad, hess)
+        fmask = self._feature_mask_in_jit(
+            jax.random.fold_in(key, 200 + k))
+        node_key = (jax.random.fold_in(
+            self._extra_key,
+            it * self.num_tree_per_iteration + k)
+            if self._use_node_rand else None)
+        grow_kw = {}
+        if quant is not None and self._use_waved() and \
+                int(self.config.num_grad_quant_bins) <= 126:
+            # int8 integer-histogram passes (the exact grower
+            # consumes the dequantized f32 values instead).
+            # |h_int| <= bins and |g_int| <= bins/2+1, so the
+            # int8 cast is exact only for bins <= 126 — larger
+            # settings stay on the f32 hist path
+            grow_kw["quant"] = quant
+        rec, row_leaf = grow(bins_fm, grad, hess, mask, fmask,
+                             self.feature_meta, self.hp,
+                             self.max_depth, self._forced,
+                             node_key, **grow_kw)
+        if self.config.use_quantized_grad and \
+                self.config.quant_train_renew_leaf:
+            rec = self._renew_leaves_in_jit(
+                rec, row_leaf, true_grad, true_hess, mask)
+        obj = self.objective
+        if obj is not None:
+            renewed_lv = obj.renew_leaves_traced(
+                rec.leaf_value, row_leaf, scores_k, mask)
+            if renewed_lv is not None:
+                rec = rec._replace(leaf_value=jnp.where(
+                    rec.num_leaves > 1, renewed_lv, rec.leaf_value))
+        return rec, row_leaf
+
+    def _make_fused(self):
+        """Build the one-XLA-program-per-iteration jit. All N-sized device
+        buffers (bin tensor, valid bins, objective label/weight/pad arrays)
+        are explicit arguments — closure capture would bake them into the
+        HLO as multi-hundred-MB literal constants and overflow compilation
+        at Higgs scale."""
+        grow = self._grow_partial()
 
         def fused(bins_fm, valid_bins, obj_state, scores, sample_mask,
                   valid_scores, it, lr):
@@ -452,40 +531,9 @@ class GBDT:
                 recs = []
                 new_valid = list(valid_scores)
                 for k in range(self.num_tree_per_iteration):
-                    grad, hess = grad_all[k], hess_all[k]
-                    mask = sample_mask
-                    if goss:
-                        mask, scale = self._goss_in_jit(
-                            jax.random.fold_in(key, 100 + k), grad, hess)
-                        grad, hess = grad * scale, hess * scale
-                    true_grad, true_hess = grad, hess
-                    quant = None
-                    if self.config.use_quantized_grad:
-                        grad, hess, quant = self._discretize_in_jit(
-                            jax.random.fold_in(key, 300 + k), grad, hess)
-                    fmask = self._feature_mask_in_jit(
-                        jax.random.fold_in(key, 200 + k))
-                    node_key = (jax.random.fold_in(
-                        self._extra_key,
-                        it * self.num_tree_per_iteration + k)
-                        if self._use_node_rand else None)
-                    grow_kw = {}
-                    if quant is not None and self._use_waved() and \
-                            int(self.config.num_grad_quant_bins) <= 126:
-                        # int8 integer-histogram passes (the exact grower
-                        # consumes the dequantized f32 values instead).
-                        # |h_int| <= bins and |g_int| <= bins/2+1, so the
-                        # int8 cast is exact only for bins <= 126 — larger
-                        # settings stay on the f32 hist path
-                        grow_kw["quant"] = quant
-                    rec, row_leaf = grow(bins_fm, grad, hess, mask, fmask,
-                                         self.feature_meta, self.hp,
-                                         self.max_depth, self._forced,
-                                         node_key, **grow_kw)
-                    if self.config.use_quantized_grad and \
-                            self.config.quant_train_renew_leaf:
-                        rec = self._renew_leaves_in_jit(
-                            rec, row_leaf, true_grad, true_hess, mask)
+                    rec, row_leaf = self._grow_class_traced(
+                        grow, bins_fm, k, key, grad_all[k], hess_all[k],
+                        sample_mask, scores[k], it)
                     # 1-leaf trees contribute nothing (the reference stops
                     # training instead, gbdt.cpp should_continue)
                     leaf_vals = jnp.where(rec.num_leaves > 1,
@@ -497,12 +545,7 @@ class GBDT:
                         new_valid[vi] = new_valid[vi].at[k].add(
                             leaf_vals[vleaf])
                     recs.append(rec)
-                if len(recs) == 1:
-                    stacked = jax.tree_util.tree_map(
-                        lambda x: x[None], recs[0])
-                else:
-                    stacked = jax.tree_util.tree_map(
-                        lambda *xs: jnp.stack(xs), *recs)
+                stacked = _stack_class_records(recs)
                 # updated objective state: objectives that evolve device
                 # state across iterations (e.g. lambdarank position
                 # biases) assign tracers to their attributes during the
@@ -550,14 +593,7 @@ class GBDT:
     def _materialize_records_inner(self) -> None:
         recs, lrs = self._device_records, self._record_lrs
         self._device_records, self._record_lrs = [], []
-        if len(recs) == 1:
-            stacked = recs[0]
-            host = jax.device_get(stacked)
-            host = jax.tree_util.tree_map(lambda x: x[None], host)
-        else:
-            stacked = jax.tree_util.tree_map(
-                lambda *xs: jnp.stack(xs), *recs)
-            host = jax.device_get(stacked)  # ONE device->host transfer set
+        host = _records_to_host(recs)
         k_per = self.num_tree_per_iteration
         for i in range(len(recs)):
             first_iter = len(self._host_models) == 0
@@ -1129,6 +1165,16 @@ class DART(GBDT):
         self._tree_weights: List[float] = []
         self._sum_tree_weight = 0.0
         self._num_init_iteration = 0
+        # fused-path state: dropped-tree contributions are recomputed on
+        # device from a [T, K, N] leaf-assignment history, so a DART
+        # iteration stays one XLA program with zero host round-trips
+        self._dart = None            # device buffers
+        self._dart_t = 0             # fused iterations stored
+        self._dart_base = 0          # _host_models index of first fused iter
+        self._dart_unshrunk: List[dict] = []  # host unshrunk records
+        self._dart_fused = None      # jitted program
+        self._dart_fast_disabled = False
+        self._cur_shrinkage = float(config.learning_rate)
 
     def init_from_loaded(self, loaded) -> None:
         super().init_from_loaded(loaded)
@@ -1136,17 +1182,334 @@ class DART(GBDT):
         self._num_init_iteration = len(self._host_models)
 
     def _tree_shrinkage(self) -> float:
-        return 1.0  # DART applies normalization itself (dart.hpp Normalize)
+        # the DART shrinkage is the drop-count-dependent factor set
+        # BEFORE the new tree trains (ref: dart.hpp:139-147
+        # shrinkage_rate_ update in DroppingTrees); the reference's
+        # Normalize never rescales the new tree — and the bias of a
+        # first tree is added AFTER this shrinkage (gbdt.cpp:426)
+        return self._cur_shrinkage
+
+    # -- fused path ----------------------------------------------------
+    def _fast_path_ok(self, custom_grad) -> bool:
+        if self._dart_fast_disabled or \
+                not self._fast_path_core_ok(custom_grad):
+            return False
+        cfg = self.config
+        if cfg.max_drop <= 0:
+            return False  # unbounded drop count has no static shape
+        # new trees grown by the host loop are missing from the device
+        # drop history; fused mode only starts on a clean booster
+        if self._dart_t == 0 and \
+                len(self._host_models) > self._num_init_iteration:
+            return False
+        k = self.num_tree_per_iteration
+        leaves = int(cfg.num_leaves)
+        t_cap = max(int(cfg.num_iterations), 64, self._dart_t * 2)
+        nv = sum(vs.num_data for vs, _ in self._valid_sets)
+        item = 1 if leaves <= 256 else (2 if leaves <= 65536 else 4)
+        need = t_cap * k * ((self.num_data + nv) * item + leaves * 4 + 4)
+        return need <= int(cfg.tpu_dart_fused_max_bytes)
+
+    def _dart_hist_dtype(self):
+        leaves = int(self.config.num_leaves)
+        return (jnp.uint8 if leaves <= 256
+                else jnp.uint16 if leaves <= 65536 else jnp.int32)
+
+    def _ensure_dart_state(self) -> None:
+        k = self.num_tree_per_iteration
+        leaves = self._static["num_leaves"]
+        dt = self._dart_hist_dtype()
+        if self._dart is None:
+            t_cap = max(int(self.config.num_iterations), 64)
+            self._dart_base = len(self._host_models)
+            self._dart = {
+                "leaf_hist": jnp.zeros((t_cap, k, self.num_data), dt),
+                "vhist": [jnp.zeros((t_cap, k, vs.num_data), dt)
+                          for vs, _ in self._valid_sets],
+                "leaf_vals": jnp.zeros((t_cap, k, leaves), jnp.float32),
+                "factors": jnp.zeros((t_cap,), jnp.float32),
+            }
+        elif self._dart_t >= self._dart["leaf_hist"].shape[0]:
+            # double capacity (continued training past num_iterations);
+            # the jit re-specializes on the new shapes automatically
+            def grow_buf(b):
+                pad = [(0, b.shape[0])] + [(0, 0)] * (b.ndim - 1)
+                return jnp.pad(b, pad)
+            st = self._dart
+            st["leaf_hist"] = grow_buf(st["leaf_hist"])
+            st["vhist"] = [grow_buf(v) for v in st["vhist"]]
+            st["leaf_vals"] = grow_buf(st["leaf_vals"])
+            st["factors"] = grow_buf(st["factors"])
+
+    def _dart_factors(self, k_drop: int):
+        """(new_factor, old_factor) as python floats
+        (ref: dart.hpp:139-147 shrinkage bookkeeping + :159 Normalize)."""
+        lr = float(self.config.learning_rate)
+        if self.config.xgboost_dart_mode:
+            new_factor = lr if k_drop == 0 else lr / (lr + k_drop)
+            old_factor = k_drop / (k_drop + lr)
+        else:
+            new_factor = lr / (1.0 + k_drop)
+            old_factor = k_drop / (k_drop + 1.0)
+        return new_factor, old_factor
+
+    def _update_drop_weights(self, drop_slots: List[int]) -> None:
+        """Weighted-mode bookkeeping after renormalizing k dropped trees
+        — shared by the host and fused paths so their tested exact parity
+        can't desynchronize (ref: dart.hpp:159-196 Normalize, including
+        the reference's xgboost-mode quirk of subtracting w/(k+lr) rather
+        than the true delta w*lr/(k+lr), dart.hpp:175,193).
+        `drop_slots` are NEW-tree indices (init offset excluded)."""
+        if self.config.uniform_drop or not drop_slots:
+            return
+        k_drop = len(drop_slots)
+        lr = float(self.config.learning_rate)
+        _new, old_factor = self._dart_factors(k_drop)
+        sub = (1.0 / (k_drop + lr) if self.config.xgboost_dart_mode
+               else 1.0 / (k_drop + 1.0))
+        for s in drop_slots:
+            self._sum_tree_weight -= self._tree_weights[s] * sub
+            self._tree_weights[s] *= old_factor
+
+    def _make_fused_dart(self):
+        """One-XLA-program DART iteration. Drop selection happens on the
+        host from host-held tree weights (no device data involved), the
+        dropped trees' score contributions are recomputed on device by
+        indexing the leaf-assignment history, and normalization
+        (dart.hpp:159) becomes a per-tree factor buffer update — the
+        model's trees materialize later as unshrunk records x factors."""
+        grow = self._grow_partial()
+        xgb_mode = bool(self.config.xgboost_dart_mode)
+        k_per = self.num_tree_per_iteration
+
+        # the reference bakes the boost-from-average bias into the first
+        # tree AFTER its score update (gbdt.cpp:426 AddBias), so dropped
+        # first trees carry the bias and later normalizations scale it.
+        # The history buffer therefore stores lv + bias/creation_factor
+        # for iteration 0: factor[t] * buffer then reproduces the
+        # reference's current leaf values at every later point in time.
+        with_bias = self._dart_base == 0 and any(
+            abs(s) > K_EPSILON for s in self.init_scores)
+        init_vec = jnp.asarray(np.asarray(self.init_scores, np.float32))
+
+        def fused(bins_fm, valid_bins, obj_state, scores, sample_mask,
+                  valid_scores, leaf_hist, vhists, leaf_vals, factors,
+                  dropped, n_drop, t_cur, it, lr):
+            obj = self.objective
+            old_state = obj.swap_device_state(obj_state)
+            try:
+                t_max = leaf_hist.shape[0]
+                key = jax.random.fold_in(self._bagging_key, it)
+                sample_mask = self._sampling_in_jit(
+                    jax.random.fold_in(key, 1), it, sample_mask)
+
+                live = dropped >= 0                      # [D]
+                d_gather = jnp.where(live, dropped, 0)
+                d_scatter = jnp.where(live, dropped, t_max)  # OOB = no-op
+                fac_d = factors[d_gather] * live.astype(jnp.float32)
+
+                def drop_delta(hist, vals):
+                    h = jnp.take(hist, d_gather, axis=0).astype(jnp.int32)
+                    v = jnp.take(vals, d_gather, axis=0) * \
+                        fac_d[:, None, None]
+                    return jnp.take_along_axis(v, h, axis=2).sum(axis=0)
+
+                delta = drop_delta(leaf_hist, leaf_vals)      # [K, N]
+                deltas_v = [drop_delta(vhists[vi], leaf_vals)
+                            for vi in range(len(valid_bins))]
+                scores_adj = scores - delta
+                grad_all, hess_all = self._grad_fn(scores_adj)
+
+                kd = n_drop.astype(jnp.float32)
+                if xgb_mode:
+                    new_factor = jnp.where(n_drop > 0, lr / (lr + kd), lr)
+                    old_factor = kd / (kd + lr)
+                else:
+                    new_factor = lr / (1.0 + kd)
+                    old_factor = kd / (kd + 1.0)
+
+                hd = leaf_hist.dtype
+                recs = []
+                new_valid = list(valid_scores)
+                new_vhists = list(vhists)
+                for k in range(k_per):
+                    rec, row_leaf = self._grow_class_traced(
+                        grow, bins_fm, k, key, grad_all[k], hess_all[k],
+                        sample_mask, scores_adj[k], it)
+                    lv = jnp.where(rec.num_leaves > 1, rec.leaf_value, 0.0)
+                    scores = scores.at[k].set(
+                        scores_adj[k] + old_factor * delta[k]
+                        + new_factor * lv[row_leaf])
+                    leaf_hist = leaf_hist.at[t_cur, k].set(
+                        row_leaf.astype(hd))
+                    lv_store = lv
+                    if with_bias:
+                        lv_store = lv + jnp.where(
+                            (t_cur == 0) & (rec.num_leaves > 1),
+                            init_vec[k] / new_factor, 0.0)
+                    leaf_vals = leaf_vals.at[t_cur, k].set(lv_store)
+                    for vi in range(len(valid_bins)):
+                        vleaf = replay_tree(rec, valid_bins[vi],
+                                            self.feature_meta, self._bundle)
+                        new_valid[vi] = new_valid[vi].at[k].set(
+                            new_valid[vi][k]
+                            - (1.0 - old_factor) * deltas_v[vi][k]
+                            + new_factor * lv[vleaf])
+                        new_vhists[vi] = new_vhists[vi].at[t_cur, k].set(
+                            vleaf.astype(hd))
+                    recs.append(rec)
+                factors = factors.at[d_scatter].multiply(old_factor)
+                factors = factors.at[t_cur].set(new_factor)
+                stacked = _stack_class_records(recs)
+                out_state = obj.device_state(evolving_only=True)
+                return (scores, sample_mask, tuple(new_valid), stacked,
+                        out_state, leaf_hist, tuple(new_vhists), leaf_vals,
+                        factors)
+            finally:
+                obj.swap_device_state(old_state)
+
+        return jax.jit(fused, donate_argnums=(3, 4, 5, 6, 7, 8, 9))
+
+    def _train_one_iter_fast(self) -> bool:
+        """Fused DART iteration (the DART twin of the GBDT fast path)."""
+        self._boost_from_average()
+        self._ensure_dart_state()
+        drop_slots = self._select_drop(self._dart_t)
+        n_drop = len(drop_slots)
+        d_cap = max(int(self.config.max_drop), 1)
+        dropped = np.full(d_cap, -1, np.int32)
+        dropped[:n_drop] = drop_slots
+        if self._dart_fused is None:
+            with global_timer.timed("train/compile_fused"):
+                self._dart_fused = self._make_fused_dart()
+        st = self._dart
+        with global_timer.timed("train/iteration",
+                                block=lambda: self.scores):
+            (self.scores, self._sample_mask, valid, recs, new_obj_state,
+             st["leaf_hist"], vhist, st["leaf_vals"],
+             st["factors"]) = self._dart_fused(
+                self.bins_fm, tuple(self._valid_bins), self._obj_state(),
+                self.scores, self._sample_mask, tuple(self._valid_scores),
+                st["leaf_hist"], tuple(st["vhist"]), st["leaf_vals"],
+                st["factors"], jnp.asarray(dropped), jnp.int32(n_drop),
+                jnp.int32(self._dart_t), jnp.int32(self.iter),
+                jnp.float32(self.config.learning_rate))
+        st["vhist"] = list(vhist)
+        if self.objective is not None:
+            self.objective.swap_device_state(new_obj_state)
+        self._valid_scores = list(valid)
+        self._device_records.append(recs)
+        self._dart_t += 1
+        self.iter += 1
+        # host weight bookkeeping — uses only host-known values (drop
+        # count), so no device sync happens
+        new_factor, _old = self._dart_factors(n_drop)
+        self._update_drop_weights(drop_slots)
+        self._tree_weights.append(new_factor)
+        self._sum_tree_weight += new_factor
+        return False
+
+    def _materialize_records_inner(self) -> None:
+        if self._dart is None:
+            return super()._materialize_records_inner()
+        # fused DART: records hold UNSHRUNK leaf values; the applied
+        # factors evolve retroactively (Normalize rescales dropped trees),
+        # so all fused-born trees are rebuilt from the kept unshrunk
+        # records x the factor buffer's current snapshot.
+        recs = self._device_records
+        self._device_records, self._record_lrs = [], []
+        if recs:
+            host = _records_to_host(recs)
+            for i in range(len(recs)):
+                self._dart_unshrunk.append(
+                    {f: np.asarray(getattr(host, f)[i])
+                     for f in host._fields})
+        factors = np.asarray(jax.device_get(self._dart["factors"]))
+        # leaf values come from the history buffer (unshrunk + the first
+        # iteration's bias/creation_factor term) x current factor — the
+        # exact quantity the device drop path subtracts, and the
+        # reference's post-Normalize leaf values (dart.hpp:159)
+        buf_vals = np.asarray(jax.device_get(self._dart["leaf_vals"]))
+        k_per = self.num_tree_per_iteration
+        base = self._dart_base
+        # incremental rebuild: only trees whose factor changed since the
+        # last snapshot (the dropped ones) plus the not-yet-built tail —
+        # a per-iteration predict() loop stays O(drops), not O(T^2)
+        prev = getattr(self, "_dart_factor_snapshot", None)
+        built = len(self._host_models) - base
+        for i, rec_all in enumerate(self._dart_unshrunk):
+            if i < built and prev is not None and i < len(prev) and \
+                    factors[i] == prev[i]:
+                continue
+            first_iter = (base + i) == 0
+            iter_trees = []
+            for k in range(k_per):
+                rec = {f: rec_all[f][k] for f in rec_all}
+                tree = Tree.from_arrays(rec, self.train_set.mappers,
+                                        self.train_set.used_features)
+                if tree.num_leaves > 1:
+                    tree.apply_shrinkage(float(factors[i]))
+                    tree.leaf_value[:] = (
+                        factors[i] * buf_vals[i][k][:len(tree.leaf_value)]
+                    ).astype(tree.leaf_value.dtype)
+                else:
+                    tree.leaf_value[:] = (self.init_scores[k]
+                                          if first_iter else 0.0)
+                iter_trees.append(tree)
+            if i < built:
+                self._host_models[base + i] = iter_trees
+            else:
+                self._host_models.append(iter_trees)
+        self._dart_factor_snapshot = factors.copy()
+
+    def _freeze_dart_fused(self) -> None:
+        """Materialize fused-born trees with their final factors and hand
+        authority to the host Tree objects (after this, Normalize mutates
+        them directly and the records must never be re-applied)."""
+        self._materialize_records()
+        self._dart_unshrunk = []
+        self._dart = None
+        self._dart_fused = None
+
+    def add_valid(self, valid_set, raw_data) -> None:
+        super().add_valid(valid_set, raw_data)
+        if self._dart_t > 0:
+            # past trees have no leaf history on the new valid set
+            self._freeze_dart_fused()
+            self._dart_fast_disabled = True
+        else:
+            self._dart = None
+            self._dart_fused = None
+
+    def rollback_one_iter(self) -> None:
+        if self.iter <= 0:
+            return
+        if self._dart_t > 0 or self._device_records:
+            # factor rewind isn't representable in the fused buffers
+            self._freeze_dart_fused()
+            self._dart_fast_disabled = True
+        super().rollback_one_iter()
+        if self._tree_weights:
+            w = self._tree_weights.pop()
+            self._sum_tree_weight -= w
 
     def train_one_iter(self, custom_grad=None, custom_hess=None) -> bool:
-        drop_idx = self._select_drop()
+        if self._fast_path_ok(custom_grad):
+            return self._train_one_iter_fast()
+        if self._dart_t > 0 or self._device_records:
+            self._freeze_dart_fused()
+        self._dart_fast_disabled = True
+        drop_idx = [self._num_init_iteration + i for i in self._select_drop(
+            len(self.models) - self._num_init_iteration)]
         # subtract dropped trees from scores (dart.hpp DroppingTrees)
         for di in drop_idx:
             self._add_tree_scores(self.models[di], sign=-1.0)
 
+        new_factor, _old = self._dart_factors(len(drop_idx))
+        self._cur_shrinkage = new_factor
         stop = super().train_one_iter(custom_grad, custom_hess)
         if not stop:
-            new_factor = self._normalize(drop_idx)
+            self._normalize(drop_idx)
             # the new tree's weight is its actual applied factor
             # (ref: dart.hpp:68 push_back(shrinkage_rate_) where
             # shrinkage_rate_ was updated by DroppingTrees :139-147)
@@ -1167,14 +1530,15 @@ class DART(GBDT):
                     jnp.asarray(sign * tree.predict(self._valid_raw(i))
                                 .astype(np.float32)))
 
-    def _select_drop(self) -> List[int]:
-        """Select iterations to drop (ref: dart.hpp:98 DroppingTrees).
-        Weighted mode drops tree i with probability proportional to its
-        current weight (ref: dart.hpp:104-116); weights shrink as trees
-        get renormalized away (Normalize), so frequently-dropped trees
-        become less likely to be dropped again."""
+    def _select_drop(self, n_new: int) -> List[int]:
+        """Select NEW-tree indices (0-based, init offset excluded) to drop
+        (ref: dart.hpp:98 DroppingTrees). Weighted mode drops tree i with
+        probability proportional to its current weight (ref:
+        dart.hpp:104-116); weights shrink as trees get renormalized away
+        (Normalize), so frequently-dropped trees become less likely to be
+        dropped again. Host-only inputs (RNG + weight floats), so the
+        fused path calls this without any device sync."""
         cfg = self.config
-        n_new = len(self.models) - self._num_init_iteration
         if n_new == 0:
             return []
         if self._drop_rng.rand() < cfg.skip_drop:
@@ -1189,7 +1553,7 @@ class DART(GBDT):
             for i in range(n_new):
                 if self._drop_rng.rand() < \
                         drop_rate * self._tree_weights[i] * inv_avg:
-                    sel.append(self._num_init_iteration + i)
+                    sel.append(i)
                     if cfg.max_drop > 0 and len(sel) >= cfg.max_drop:
                         break
         else:
@@ -1197,53 +1561,22 @@ class DART(GBDT):
                 drop_rate = min(drop_rate, cfg.max_drop / n_new)
             for i in range(n_new):
                 if self._drop_rng.rand() < drop_rate:
-                    sel.append(self._num_init_iteration + i)
+                    sel.append(i)
                     if cfg.max_drop > 0 and len(sel) >= cfg.max_drop:
                         break
         return sel
 
-    def _normalize(self, drop_idx: List[int]) -> float:
-        """Scale the new tree by the DART shrinkage and the dropped trees
-        to k/(k+1) (or k/(k+lr) in xgboost mode) of their old weight
-        (ref: dart.hpp:159 Normalize + shrinkage_rate_ update :139-147).
-        Returns the new tree's applied factor."""
-        k_drop = len(drop_idx)
-        lr = self.config.learning_rate
-        new_trees = self.models[-1]
-        if self.config.xgboost_dart_mode:
-            new_factor = lr if k_drop == 0 else lr / (lr + k_drop)
-            old_factor = k_drop / (k_drop + lr)
-        else:
-            new_factor = lr / (1.0 + k_drop)
-            old_factor = k_drop / (k_drop + 1.0)
-        for k, tree in enumerate(new_trees):
-            # shrink the new tree
-            delta = (new_factor - 1.0)
-            leaves = self._predict_leaf_binned_train(tree)
-            self.scores = self.scores.at[k].add(jnp.asarray(
-                (tree.leaf_value * delta).astype(np.float32))[leaves])
-            for i, (vs, raw) in enumerate(self._valid_sets):
-                self._valid_scores[i] = self._valid_scores[i].at[k].add(
-                    jnp.asarray((tree.predict(self._valid_raw(i)) * delta)
-                                .astype(np.float32)))
-            tree.apply_shrinkage(new_factor)
-        # scale the dropped trees + their drop weights
-        # (ref: dart.hpp:159-196 Normalize weight bookkeeping)
+    def _normalize(self, drop_idx: List[int]) -> None:
+        """Scale the DROPPED trees to k/(k+1) (or k/(k+lr) in xgboost
+        mode) of their old weight (ref: dart.hpp:159 Normalize — the new
+        tree was already created at its final factor, like the
+        reference's Shrinkage(shrinkage_rate_) at gbdt.cpp:423)."""
+        _new_factor, old_factor = self._dart_factors(len(drop_idx))
         for di in drop_idx:
             for tree in self.models[di]:
                 tree.apply_shrinkage(old_factor)
-            if not self.config.uniform_drop:
-                wi = di - self._num_init_iteration
-                # mirror the reference's bookkeeping exactly, including
-                # its xgboost-mode quirk of subtracting w/(k+lr) rather
-                # than the true delta w*lr/(k+lr) (dart.hpp:175,193)
-                if self.config.xgboost_dart_mode:
-                    sub = 1.0 / (k_drop + lr)
-                else:
-                    sub = 1.0 / (k_drop + 1.0)
-                self._sum_tree_weight -= self._tree_weights[wi] * sub
-                self._tree_weights[wi] *= old_factor
-        return new_factor
+        self._update_drop_weights(
+            [di - self._num_init_iteration for di in drop_idx])
 
 
 class RF(GBDT):

@@ -1,0 +1,216 @@
+"""In-process backend of the C-ABI shim.
+
+`native/src/lgbm_tpu_capi.cpp` embeds a CPython interpreter, imports this
+module, and forwards every `LGBM_*` call here with raw pointers passed as
+integers. This module wraps those pointers with ctypes/NumPy, drives the
+ordinary Python API (`basic.Dataset`/`basic.Booster`), and returns
+primitive values the C side can marshal back — giving reference harnesses
+and third-party tooling the familiar `lib_lightgbm` calling convention
+(ref: include/LightGBM/c_api.h; internal Booster wrapper c_api.cpp:170).
+
+Handles are small integers into a registry (the C side casts them to the
+opaque `DatasetHandle`/`BoosterHandle` pointers the reference API uses).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict
+
+import numpy as np
+
+if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+    # honor an explicit CPU pin even under the axon sitecustomize, whose
+    # PJRT plugin overrides JAX_PLATFORMS (see hostenv.cpu_child_env)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+from .basic import Booster, Dataset
+from .config import Config
+
+# C_API_DTYPE_* (ref: c_api.h:36-39)
+_DTYPES = {0: ctypes.c_float, 1: ctypes.c_double,
+           2: ctypes.c_int32, 3: ctypes.c_int64}
+_NP_DTYPES = {0: np.float32, 1: np.float64, 2: np.int32, 3: np.int64}
+
+# C_API_PREDICT_* (ref: c_api.h:41-44)
+_PREDICT_NORMAL, _PREDICT_RAW, _PREDICT_LEAF, _PREDICT_CONTRIB = range(4)
+
+_registry: Dict[int, object] = {}
+_next_handle = [1]
+
+
+def _new_handle(obj) -> int:
+    h = _next_handle[0]
+    _next_handle[0] += 1
+    _registry[h] = obj
+    return h
+
+
+def _get(handle: int):
+    try:
+        return _registry[handle]
+    except KeyError:
+        raise ValueError(f"invalid handle {handle}")
+
+
+def _array_from_ptr(ptr: int, count: int, dtype: int) -> np.ndarray:
+    if count == 0:
+        return np.empty(0, _NP_DTYPES[dtype])
+    ct = _DTYPES[dtype]
+    buf = (ct * count).from_address(ptr)
+    return np.asarray(np.ctypeslib.as_array(buf), _NP_DTYPES[dtype]).copy()
+
+
+def _write_doubles(ptr: int, values: np.ndarray) -> int:
+    values = np.ascontiguousarray(values, np.float64)
+    ctypes.memmove(ptr, values.ctypes.data, values.nbytes)
+    return int(values.size)
+
+
+def _parse_params(parameters: str) -> Dict[str, str]:
+    return Config.kv2map((parameters or "").split())
+
+
+# -- dataset ---------------------------------------------------------------
+def dataset_create_from_mat(data_ptr: int, data_type: int, nrow: int,
+                            ncol: int, is_row_major: int, parameters: str,
+                            reference: int) -> int:
+    """(ref: LGBM_DatasetCreateFromMat c_api.cpp:1311)"""
+    flat = _array_from_ptr(data_ptr, nrow * ncol, data_type)
+    mat = (flat.reshape(nrow, ncol) if is_row_major
+           else flat.reshape(ncol, nrow).T)
+    ref = _get(reference) if reference else None
+    ds = Dataset(np.asarray(mat, np.float64), reference=ref,
+                 params=_parse_params(parameters))
+    return _new_handle(ds)
+
+
+def dataset_create_from_file(filename: str, parameters: str,
+                             reference: int) -> int:
+    """(ref: LGBM_DatasetCreateFromFile c_api.cpp:1044)"""
+    ref = _get(reference) if reference else None
+    ds = Dataset(filename, reference=ref, params=_parse_params(parameters))
+    return _new_handle(ds)
+
+
+def dataset_set_field(handle: int, field: str, ptr: int, count: int,
+                      dtype: int) -> None:
+    """(ref: LGBM_DatasetSetField c_api.cpp)"""
+    ds = _get(handle)
+    values = _array_from_ptr(ptr, count, dtype)
+    if field == "label":
+        ds.set_label(values)
+    elif field == "weight":
+        ds.set_weight(values)
+    elif field in ("group", "query"):
+        ds.set_group(values)
+    elif field == "init_score":
+        ds.set_init_score(values)
+    else:
+        raise ValueError(f"unknown field {field}")
+
+
+def dataset_num_data(handle: int) -> int:
+    return int(_get(handle).num_data())
+
+
+def dataset_num_feature(handle: int) -> int:
+    return int(_get(handle).num_feature())
+
+
+def handle_free(handle: int) -> None:
+    _registry.pop(handle, None)
+    _eval_counts.pop(handle, None)
+
+
+# -- booster ---------------------------------------------------------------
+def booster_create(train_handle: int, parameters: str) -> int:
+    """(ref: LGBM_BoosterCreate c_api.cpp:1998)"""
+    bst = Booster(_parse_params(parameters), _get(train_handle))
+    return _new_handle(bst)
+
+
+def booster_create_from_modelfile(filename: str) -> tuple:
+    """(ref: LGBM_BoosterCreateFromModelfile)"""
+    bst = Booster(model_file=filename)
+    return _new_handle(bst), int(bst.num_trees())
+
+
+def booster_add_valid_data(handle: int, valid_handle: int) -> None:
+    bst = _get(handle)
+    bst.add_valid(_get(valid_handle),
+                  f"valid_{len(bst._name_valid_sets)}")
+
+
+def booster_update_one_iter(handle: int) -> int:
+    """Returns 1 when training is finished
+    (ref: LGBM_BoosterUpdateOneIter c_api.cpp:2121)."""
+    return int(bool(_get(handle).update()))
+
+
+def booster_current_iteration(handle: int) -> int:
+    return int(_get(handle).current_iteration())
+
+
+_eval_counts: Dict[int, int] = {}
+
+
+def booster_get_eval_counts(handle: int) -> int:
+    # the metric set is fixed after Booster creation; cache so harnesses
+    # polling the count each iteration don't pay a full evaluation
+    if handle not in _eval_counts:
+        _eval_counts[handle] = len(_get(handle).eval_train())
+    return _eval_counts[handle]
+
+
+def booster_get_eval(handle: int, data_idx: int, out_ptr: int) -> int:
+    """data_idx 0 = train, 1.. = valid sets (ref: LGBM_BoosterGetEval)."""
+    bst = _get(handle)
+    if data_idx == 0:
+        results = bst.eval_train()
+    else:
+        name = bst._name_valid_sets[data_idx - 1]
+        results = [r for r in bst.eval_valid() if r[0] == name]
+    return _write_doubles(out_ptr, np.asarray([r[2] for r in results]))
+
+
+def booster_predict_for_mat(handle: int, data_ptr: int, data_type: int,
+                            nrow: int, ncol: int, is_row_major: int,
+                            predict_type: int, start_iteration: int,
+                            num_iteration: int, out_ptr: int) -> int:
+    """(ref: LGBM_BoosterPredictForMat c_api.cpp:2558)"""
+    bst = _get(handle)
+    flat = _array_from_ptr(data_ptr, nrow * ncol, data_type)
+    mat = (flat.reshape(nrow, ncol) if is_row_major
+           else flat.reshape(ncol, nrow).T)
+    pred = bst.predict(np.asarray(mat, np.float64),
+                       start_iteration=start_iteration,
+                       num_iteration=num_iteration,
+                       raw_score=predict_type == _PREDICT_RAW,
+                       pred_leaf=predict_type == _PREDICT_LEAF,
+                       pred_contrib=predict_type == _PREDICT_CONTRIB)
+    return _write_doubles(out_ptr, np.asarray(pred).reshape(-1))
+
+
+def booster_save_model(handle: int, start_iteration: int,
+                       num_iteration: int, importance_type: int,
+                       filename: str) -> None:
+    """(ref: LGBM_BoosterSaveModel)"""
+    _get(handle).save_model(
+        filename, num_iteration=num_iteration,
+        start_iteration=start_iteration,
+        importance_type="gain" if importance_type == 1 else "split")
+
+
+def booster_save_model_to_string(handle: int, start_iteration: int,
+                                 num_iteration: int,
+                                 importance_type: int) -> str:
+    return _get(handle).model_to_string(
+        num_iteration=num_iteration, start_iteration=start_iteration,
+        importance_type="gain" if importance_type == 1 else "split")
+
+
+def booster_num_feature(handle: int) -> int:
+    return int(_get(handle).num_feature())

@@ -171,6 +171,7 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_donate_buffers": [],
     "tpu_wave_max": [],
     "tpu_hist_precision": [],
+    "tpu_dart_fused_max_bytes": [],
 }
 
 _ALIAS_TO_CANONICAL: Dict[str, str] = {}
@@ -448,6 +449,11 @@ class Config:
     # Measured on the TPU chip: "default" matches "highest" AUC to
     # ~1e-3 at Higgs shape while cutting iteration time ~2x.
     tpu_hist_precision: str = "default"
+    # DART fused-path budget: the per-tree leaf-assignment history
+    # ([T, K, N] device buffer that lets dropped-tree contributions be
+    # recomputed without host round-trips) is only kept below this many
+    # bytes; above it DART falls back to the host loop.
+    tpu_dart_fused_max_bytes: int = 2 << 30
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)

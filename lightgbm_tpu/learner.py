@@ -201,8 +201,10 @@ def grow_tree(bins_fm: jax.Array,
     sample_mask: [N] float {0,1} bagging/GOSS selection (excluded rows still
     get a leaf assignment for score updates, but contribute no statistics —
     ref: bagging keeps full score updates, gbdt.cpp:502).
-    forced: optional (leaf [L-1], feature [L-1], threshold_bin [L-1]) int32
-    arrays; entries >= 0 force that split at that scan step
+    forced: optional (leaf [L-1], feature [L-1], threshold_bin [L-1],
+    is_categorical [L-1] bool) arrays; leaf entries >= 0 force that split
+    at that scan step — numerical splits on bin <= threshold, categorical
+    as the one-vs-rest bitset on the threshold's bin
     (ref: serial_tree_learner.cpp:628 ForceSplits).
     interaction_groups: optional [G, F] bool array of allowed feature
     combinations (ref: config.h interaction_constraints).
@@ -291,8 +293,8 @@ def grow_tree(bins_fm: jax.Array,
 
     if forced is None:
         neg1 = jnp.full((L - 1,), -1, jnp.int32)
-        forced = (neg1, neg1, neg1)
-    forced_leaf_arr, forced_feat_arr, forced_thr_arr = forced
+        forced = (neg1, neg1, neg1, jnp.zeros((L - 1,), jnp.bool_))
+    forced_leaf_arr, forced_feat_arr, forced_thr_arr, forced_cat_arr = forced
 
     def step(state: _GrowState, step_idx):
         leaves = state.leaves
@@ -304,9 +306,17 @@ def grow_tree(bins_fm: jax.Array,
         f_leaf = jnp.maximum(forced_leaf_arr[step_idx], 0)
         f_feat = jnp.maximum(forced_feat_arr[step_idx], 0)
         f_thr = forced_thr_arr[step_idx]
+        f_is_cat = forced_cat_arr[step_idx]
         f_hist = state.pool[f_leaf]
-        bin_le = (jnp.arange(f_hist.shape[1]) <= f_thr)
-        f_left = jnp.sum(f_hist[f_feat] * bin_le[:, None], axis=0)
+        # numerical: cumulative bins <= threshold go left; categorical:
+        # one-vs-rest on the forced category's bin (ref:
+        # feature_histogram.hpp GatherInfoForThreshold{Numerical,
+        # Categorical} — the reference's forced categorical split is the
+        # single-category bitset, tree.h:375)
+        bin_eq = (jnp.arange(f_hist.shape[1]) == f_thr)
+        bin_sel = jnp.where(f_is_cat, bin_eq,
+                            jnp.arange(f_hist.shape[1]) <= f_thr)
+        f_left = jnp.sum(f_hist[f_feat] * bin_sel[:, None], axis=0)
         f_pg, f_ph, f_pc = (leaves.sum_grad[f_leaf], leaves.sum_hess[f_leaf],
                             leaves.count[f_leaf])
         f_lg, f_lh, f_lc = f_left[GRAD], f_left[HESS], f_left[COUNT]
@@ -324,13 +334,15 @@ def grow_tree(bins_fm: jax.Array,
                               jnp.argmax(leaves.gain).astype(jnp.int32))
         feat = jnp.where(use_forced, f_feat, leaves.feature[best_leaf])
         thr = jnp.where(use_forced, f_thr, leaves.threshold[best_leaf])
-        # forced splits route missing by the zero-bin rule
-        forced_dleft = (meta.missing_type[feat] == split_ops.MISSING_ZERO) \
-            & (meta.default_bin[feat] <= thr)
+        # forced splits route missing by the zero-bin rule (categorical
+        # partitioning ignores default_left: membership in cat_mask decides)
+        forced_dleft = (~f_is_cat) & \
+            (meta.missing_type[feat] == split_ops.MISSING_ZERO) & \
+            (meta.default_bin[feat] <= thr)
         dleft = jnp.where(use_forced, forced_dleft,
                           leaves.default_left[best_leaf])
-        cat_mask = jnp.where(use_forced,
-                             jnp.zeros_like(leaves.cat_mask[0]),
+        forced_cat_mask = bin_eq[:leaves.cat_mask.shape[1]] & f_is_cat
+        cat_mask = jnp.where(use_forced, forced_cat_mask,
                              leaves.cat_mask[best_leaf])
 
         # --- children stats: stored candidate, or the forced gather

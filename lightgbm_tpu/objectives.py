@@ -115,6 +115,16 @@ class ObjectiveFunction:
         RenewTreeOutput for L1-family objectives). Returns tree or None."""
         return None
 
+    def renew_leaves_traced(self, leaf_value, row_leaf, score, mask):
+        """Traced twin of `renew_tree_output` for the fused fast path:
+        given device arrays (leaf_value [L], row_leaf [N], score [N],
+        sample mask [N]) return renewed leaf values [L], or None when the
+        objective has no device renewal. Objectives overriding
+        `renew_tree_output` should override this too so they keep the
+        one-XLA-program-per-iteration path (the reference's equivalent
+        host work runs inside the training loop, gbdt.cpp:420)."""
+        return None
+
     def _weights_or_ones(self):
         if self.weight_np is not None:
             return self.weight_np.astype(np.float64)
@@ -171,6 +181,11 @@ class RegressionL1(RegressionL2):
                                     self._weights_or_ones(), row_leaf_np,
                                     sample_mask_np, 0.5)
 
+    def renew_leaves_traced(self, leaf_value, row_leaf, score, mask):
+        w = self.weight if self.weight is not None else jnp.ones_like(score)
+        return _percentile_renew_traced(leaf_value, row_leaf,
+                                        self.label - score, w, mask, 0.5)
+
 
 class Huber(RegressionL2):
     name = "huber"
@@ -185,6 +200,11 @@ class Huber(RegressionL2):
         return _renew_by_percentile(tree, self.label_np - score_np,
                                     self._weights_or_ones(), row_leaf_np,
                                     sample_mask_np, 0.5)
+
+    def renew_leaves_traced(self, leaf_value, row_leaf, score, mask):
+        w = self.weight if self.weight is not None else jnp.ones_like(score)
+        return _percentile_renew_traced(leaf_value, row_leaf,
+                                        self.label - score, w, mask, 0.5)
 
 
 class Fair(RegressionL2):
@@ -233,6 +253,12 @@ class Quantile(RegressionL2):
                                     self._weights_or_ones(), row_leaf_np,
                                     sample_mask_np, self.config.alpha)
 
+    def renew_leaves_traced(self, leaf_value, row_leaf, score, mask):
+        w = self.weight if self.weight is not None else jnp.ones_like(score)
+        return _percentile_renew_traced(leaf_value, row_leaf,
+                                        self.label - score, w, mask,
+                                        self.config.alpha)
+
 
 class MAPE(RegressionL2):
     name = "mape"
@@ -256,6 +282,11 @@ class MAPE(RegressionL2):
                                     self._weights_or_ones() * self._trans,
                                     row_leaf_np, sample_mask_np, 0.5)
 
+    def renew_leaves_traced(self, leaf_value, row_leaf, score, mask):
+        w = self.trans if self.weight is None else self.weight * self.trans
+        return _percentile_renew_traced(leaf_value, row_leaf,
+                                        self.label - score, w, mask, 0.5)
+
 
 class Gamma(Poisson):
     name = "gamma"
@@ -275,6 +306,37 @@ class Tweedie(Poisson):
         grad = -self.label * e1 + e2
         hess = -self.label * (1.0 - rho) * e1 + (2.0 - rho) * e2
         return self._apply_weight(grad, hess)
+
+
+def _percentile_renew_traced(leaf_value, row_leaf, residual, weights, mask,
+                             alpha):
+    """Traced per-leaf weighted percentile: the device twin of
+    `_renew_by_percentile` (ref: RegressionL1loss::RenewTreeOutput +
+    PercentileFun, regression_objective.hpp:23-60), restructured for XLA:
+    one lexicographic sort by (leaf, residual) replaces the per-leaf
+    host loops, then each leaf's percentile index is a searchsorted into
+    the global weight cumsum restricted to its segment."""
+    num_slots = leaf_value.shape[0]
+    n = residual.shape[0]
+    valid = mask > 0
+    leaf = jnp.where(valid, row_leaf, num_slots).astype(jnp.int32)
+    res = residual.astype(jnp.float32)
+    w = jnp.where(valid, weights, 0.0).astype(jnp.float32)
+    leaf_s, res_s, w_s = jax.lax.sort((leaf, res, w), num_keys=2)
+    cumw = jnp.cumsum(w_s)
+    ids = jnp.arange(num_slots)
+    start = jnp.searchsorted(leaf_s, ids, side="left")
+    end = jnp.searchsorted(leaf_s, ids, side="right")
+    base = jnp.where(start > 0, cumw[jnp.clip(start - 1, 0, n - 1)], 0.0)
+    endw = jnp.where(end > 0, cumw[jnp.clip(end - 1, 0, n - 1)], 0.0)
+    total = endw - base
+    # first in-segment index where cumulative weight reaches alpha*total
+    # (== np.searchsorted(cw_local, alpha * cw_local[-1]) in the host twin)
+    idx = jnp.searchsorted(cumw, base + alpha * total, side="left")
+    idx = jnp.clip(idx, start, jnp.maximum(end - 1, start))
+    vals = res_s[jnp.clip(idx, 0, n - 1)]
+    occupied = (end > start) & (total > 0)
+    return jnp.where(occupied, vals, leaf_value)
 
 
 def _renew_by_percentile(tree, residual, weights, row_leaf, sample_mask,

@@ -1,0 +1,376 @@
+// C-ABI compatibility shim: a subset of the reference's `LGBM_*` surface
+// (ref: include/LightGBM/c_api.h, 131 functions; this shim covers the ~17
+// that dataset/booster lifecycle harnesses use) backed by the
+// lightgbm_tpu Python framework through an embedded CPython interpreter.
+//
+// Design: every entry point forwards to lightgbm_tpu.capi with raw
+// pointers passed as integers; that module wraps them with ctypes/NumPy
+// and drives the ordinary Python API. Handles returned to C callers are
+// small registry integers cast to opaque pointers — the same contract as
+// the reference's DatasetHandle/BoosterHandle (c_api.h:28-34).
+//
+// The reference guards its Booster with shared/unique locks
+// (c_api.cpp:170); here the GIL serves the same role: every call takes
+// PyGILState_Ensure, so concurrent callers serialize safely.
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+#define LGBM_API extern "C" __attribute__((visibility("default")))
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+static thread_local std::string g_last_error = "everything is fine";
+static PyObject* g_capi_module = nullptr;
+static std::once_flag g_py_once;
+
+LGBM_API const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+namespace {
+
+void EnsureInterpreter() {
+  std::call_once(g_py_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      // Release the GIL taken by Py_Initialize so PyGILState_Ensure
+      // works uniformly from every (including this) thread.
+      PyEval_SaveThread();
+    }
+  });
+}
+
+// RAII GIL + lazy import of lightgbm_tpu.capi.
+class Gil {
+ public:
+  Gil() : state_(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(state_); }
+  Gil(const Gil&) = delete;
+  Gil& operator=(const Gil&) = delete;
+
+ private:
+  PyGILState_STATE state_;
+};
+
+PyObject* CapiModule() {
+  if (g_capi_module == nullptr) {
+    g_capi_module = PyImport_ImportModule("lightgbm_tpu.capi");
+  }
+  return g_capi_module;
+}
+
+std::string FetchPyError() {
+  PyObject *type = nullptr, *value = nullptr, *trace = nullptr;
+  PyErr_Fetch(&type, &value, &trace);
+  PyErr_NormalizeException(&type, &value, &trace);
+  std::string msg = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) msg = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(trace);
+  return msg;
+}
+
+// Call lightgbm_tpu.capi.<fn>(args...) and return the result (new ref),
+// or nullptr with g_last_error set.
+PyObject* Call(const char* fn, const char* fmt, ...) {
+  PyObject* mod = CapiModule();
+  if (mod == nullptr) {
+    g_last_error = "failed to import lightgbm_tpu.capi: " + FetchPyError();
+    return nullptr;
+  }
+  PyObject* func = PyObject_GetAttrString(mod, fn);
+  if (func == nullptr) {
+    PyErr_Clear();  // a pending exception would poison later calls
+    g_last_error = std::string("missing capi function ") + fn;
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  PyObject* result = nullptr;
+  if (args != nullptr) {
+    result = PyObject_CallObject(func, args);
+    Py_DECREF(args);
+  }
+  Py_DECREF(func);
+  if (result == nullptr) {
+    g_last_error = std::string(fn) + ": " + FetchPyError();
+    return nullptr;
+  }
+  return result;
+}
+
+int HandleResult(PyObject* r) {
+  if (r == nullptr) return -1;
+  Py_DECREF(r);
+  return 0;
+}
+
+int64_t AsHandleInt(void* h) { return reinterpret_cast<intptr_t>(h); }
+
+}  // namespace
+
+// -- dataset ---------------------------------------------------------------
+
+LGBM_API int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                                       int32_t nrow, int32_t ncol,
+                                       int is_row_major,
+                                       const char* parameters,
+                                       const DatasetHandle reference,
+                                       DatasetHandle* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_create_from_mat", "(LiiiisL)",
+                     (long long)(intptr_t)data, data_type, (int)nrow,
+                     (int)ncol, is_row_major, parameters ? parameters : "",
+                     (long long)AsHandleInt(reference));
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetCreateFromFile(const char* filename,
+                                        const char* parameters,
+                                        const DatasetHandle reference,
+                                        DatasetHandle* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_create_from_file", "(ssL)", filename,
+                     parameters ? parameters : "",
+                     (long long)AsHandleInt(reference));
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetSetField(DatasetHandle handle,
+                                  const char* field_name,
+                                  const void* field_data, int num_element,
+                                  int type) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_set_field", "(LsLii)",
+                           (long long)AsHandleInt(handle), field_name,
+                           (long long)(intptr_t)field_data, num_element,
+                           type));
+}
+
+LGBM_API int LGBM_DatasetGetNumData(DatasetHandle handle, int32_t* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_num_data", "(L)",
+                     (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  *out = (int32_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetGetNumFeature(DatasetHandle handle, int32_t* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_num_feature", "(L)",
+                     (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  *out = (int32_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetFree(DatasetHandle handle) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("handle_free", "(L)",
+                           (long long)AsHandleInt(handle)));
+}
+
+// -- booster ---------------------------------------------------------------
+
+LGBM_API int LGBM_BoosterCreate(const DatasetHandle train_data,
+                                const char* parameters,
+                                BoosterHandle* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_create", "(Ls)",
+                     (long long)AsHandleInt(train_data),
+                     parameters ? parameters : "");
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<BoosterHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterCreateFromModelfile(const char* filename,
+                                             int* out_num_iterations,
+                                             BoosterHandle* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_create_from_modelfile", "(s)", filename);
+  if (r == nullptr) return -1;
+  long long handle = 0;
+  int iters = 0;
+  if (!PyArg_ParseTuple(r, "Li", &handle, &iters)) {
+    PyErr_Clear();  // a pending exception would poison later calls
+    Py_DECREF(r);
+    g_last_error = "bad tuple from booster_create_from_modelfile";
+    return -1;
+  }
+  Py_DECREF(r);
+  *out = reinterpret_cast<BoosterHandle>((intptr_t)handle);
+  *out_num_iterations = iters;
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterAddValidData(BoosterHandle handle,
+                                      const DatasetHandle valid_data) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_add_valid_data", "(LL)",
+                           (long long)AsHandleInt(handle),
+                           (long long)AsHandleInt(valid_data)));
+}
+
+LGBM_API int LGBM_BoosterUpdateOneIter(BoosterHandle handle,
+                                       int* is_finished) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_update_one_iter", "(L)",
+                     (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  *is_finished = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetCurrentIteration(BoosterHandle handle,
+                                             int* out_iteration) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_current_iteration", "(L)",
+                     (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  *out_iteration = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetEvalCounts(BoosterHandle handle, int* out_len) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_get_eval_counts", "(L)",
+                     (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  *out_len = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetEval(BoosterHandle handle, int data_idx,
+                                 int* out_len, double* out_results) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_get_eval", "(LiL)",
+                     (long long)AsHandleInt(handle), data_idx,
+                     (long long)(intptr_t)out_results);
+  if (r == nullptr) return -1;
+  *out_len = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterPredictForMat(BoosterHandle handle,
+                                       const void* data, int data_type,
+                                       int32_t nrow, int32_t ncol,
+                                       int is_row_major, int predict_type,
+                                       int start_iteration,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  (void)parameter;
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_predict_for_mat", "(LLiiiiiiiL)",
+                     (long long)AsHandleInt(handle),
+                     (long long)(intptr_t)data, data_type, (int)nrow,
+                     (int)ncol, is_row_major, predict_type,
+                     start_iteration, num_iteration,
+                     (long long)(intptr_t)out_result);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterSaveModel(BoosterHandle handle,
+                                   int start_iteration, int num_iteration,
+                                   int feature_importance_type,
+                                   const char* filename) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_save_model", "(Liiis)",
+                           (long long)AsHandleInt(handle), start_iteration,
+                           num_iteration, feature_importance_type,
+                           filename));
+}
+
+LGBM_API int LGBM_BoosterSaveModelToString(BoosterHandle handle,
+                                           int start_iteration,
+                                           int num_iteration,
+                                           int feature_importance_type,
+                                           int64_t buffer_len,
+                                           int64_t* out_len,
+                                           char* out_str) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_save_model_to_string", "(Liii)",
+                     (long long)AsHandleInt(handle), start_iteration,
+                     num_iteration, feature_importance_type);
+  if (r == nullptr) return -1;
+  Py_ssize_t size = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &size);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    g_last_error = "model string encode failed";
+    return -1;
+  }
+  *out_len = (int64_t)size + 1;  // including trailing '\0', like the ref
+  if (buffer_len >= size + 1) {
+    std::memcpy(out_str, s, size + 1);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetNumFeature(BoosterHandle handle, int* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_num_feature", "(L)",
+                     (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterFree(BoosterHandle handle) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("handle_free", "(L)",
+                           (long long)AsHandleInt(handle)));
+}

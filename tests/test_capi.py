@@ -1,0 +1,227 @@
+"""C-ABI shim tests: drive the framework through lib_lightgbm_tpu.so the
+way reference harnesses drive lib_lightgbm.so (ref: include/LightGBM/
+c_api.h; tests/c_api_test/test_.py is the reference's ctypes smoke test).
+
+Two tiers: ctypes from this process (cheap), and a genuinely external C
+program that embeds the interpreter through the shim (the third-party
+tooling path)."""
+
+import ctypes
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from conftest import make_binary
+
+REPO = Path(__file__).resolve().parent.parent
+SO_PATH = REPO / "lightgbm_tpu" / "lib_lightgbm_tpu.so"
+
+
+def _ensure_built():
+    if not SO_PATH.exists():
+        subprocess.run(["make", "-C", str(REPO / "native"), "capi"],
+                       check=True, capture_output=True)
+    return SO_PATH
+
+
+@pytest.fixture(scope="module")
+def lib():
+    lib = ctypes.CDLL(str(_ensure_built()))
+    lib.LGBM_GetLastError.restype = ctypes.c_char_p
+    return lib
+
+
+def _check(lib, rc):
+    assert rc == 0, lib.LGBM_GetLastError().decode()
+
+
+class TestCApiInProcess:
+    def test_dataset_booster_lifecycle(self, lib):
+        X, y = make_binary(500, 6)
+        X64 = np.ascontiguousarray(X, np.float64)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X64.ctypes.data_as(ctypes.c_void_p), 1,  # C_API_DTYPE_FLOAT64
+            ctypes.c_int32(X64.shape[0]), ctypes.c_int32(X64.shape[1]),
+            1, b"max_bin=63", None, ctypes.byref(ds)))
+        y32 = np.ascontiguousarray(y, np.float32)
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y32.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(len(y32)), 0))  # C_API_DTYPE_FLOAT32
+
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+        assert n.value == 500
+        _check(lib, lib.LGBM_DatasetGetNumFeature(ds, ctypes.byref(n)))
+        assert n.value == 6
+
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=15 min_data_in_leaf=5 "
+                b"metric=auc verbosity=-1", ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(10):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst,
+                                                      ctypes.byref(fin)))
+        it = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst,
+                                                        ctypes.byref(it)))
+        assert it.value == 10
+
+        # train AUC via GetEval(data_idx=0)
+        cnt = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterGetEvalCounts(bst, ctypes.byref(cnt)))
+        assert cnt.value >= 1
+        res = (ctypes.c_double * cnt.value)()
+        out_len = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterGetEval(bst, 0, ctypes.byref(out_len),
+                                            res))
+        assert out_len.value == cnt.value
+        assert res[0] > 0.8  # AUC on train
+
+        # predict (normal = probability)
+        out = (ctypes.c_double * 500)()
+        out_len64 = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, X64.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(500), ctypes.c_int32(6), 1, 0, 0, -1, b"",
+            ctypes.byref(out_len64), out))
+        assert out_len64.value == 500
+        pred = np.asarray(out[:500])
+        assert 0.0 <= pred.min() and pred.max() <= 1.0
+        auc_gap = pred[y > 0.5].mean() - pred[y <= 0.5].mean()
+        assert auc_gap > 0.2
+
+        # save -> load -> identical raw predictions
+        import tempfile
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "model.txt")
+            _check(lib, lib.LGBM_BoosterSaveModel(bst, 0, -1, 0,
+                                                  path.encode()))
+            loaded = ctypes.c_void_p()
+            iters = ctypes.c_int()
+            _check(lib, lib.LGBM_BoosterCreateFromModelfile(
+                path.encode(), ctypes.byref(iters), ctypes.byref(loaded)))
+            assert iters.value == 10
+            out2 = (ctypes.c_double * 500)()
+            _check(lib, lib.LGBM_BoosterPredictForMat(
+                loaded, X64.ctypes.data_as(ctypes.c_void_p), 1,
+                ctypes.c_int32(500), ctypes.c_int32(6), 1, 1, 0, -1, b"",
+                ctypes.byref(out_len64), out2))
+            out1 = (ctypes.c_double * 500)()
+            _check(lib, lib.LGBM_BoosterPredictForMat(
+                bst, X64.ctypes.data_as(ctypes.c_void_p), 1,
+                ctypes.c_int32(500), ctypes.c_int32(6), 1, 1, 0, -1, b"",
+                ctypes.byref(out_len64), out1))
+            np.testing.assert_allclose(np.asarray(out2[:500]),
+                                       np.asarray(out1[:500]),
+                                       rtol=1e-5, atol=1e-6)
+            _check(lib, lib.LGBM_BoosterFree(loaded))
+
+        # model string
+        buf_len = 1 << 20
+        buf = ctypes.create_string_buffer(buf_len)
+        str_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterSaveModelToString(
+            bst, 0, -1, 0, ctypes.c_int64(buf_len), ctypes.byref(str_len),
+            buf))
+        assert 0 < str_len.value <= buf_len
+        assert buf.value.decode().startswith("tree")
+
+        _check(lib, lib.LGBM_BoosterFree(bst))
+        _check(lib, lib.LGBM_DatasetFree(ds))
+
+    def test_error_reporting(self, lib):
+        bst = ctypes.c_void_p(0)
+        fin = ctypes.c_int()
+        rc = lib.LGBM_BoosterUpdateOneIter(
+            ctypes.c_void_p(999999), ctypes.byref(fin))
+        assert rc != 0
+        assert b"invalid handle" in lib.LGBM_GetLastError()
+
+
+C_DRIVER = r"""
+#include <stdio.h>
+#include <stdlib.h>
+
+typedef void* H;
+extern int LGBM_DatasetCreateFromMat(const void*, int, int, int, int,
+                                     const char*, H, H*);
+extern int LGBM_DatasetSetField(H, const char*, const void*, int, int);
+extern int LGBM_BoosterCreate(H, const char*, H*);
+extern int LGBM_BoosterUpdateOneIter(H, int*);
+extern int LGBM_BoosterPredictForMat(H, const void*, int, int, int, int,
+                                     int, int, int, const char*,
+                                     long long*, double*);
+extern int LGBM_BoosterFree(H);
+extern int LGBM_DatasetFree(H);
+extern const char* LGBM_GetLastError(void);
+
+#define CHECK(x) if ((x) != 0) { \
+    fprintf(stderr, "FAIL: %s\n", LGBM_GetLastError()); return 1; }
+
+int main(void) {
+  enum { N = 200, F = 4 };
+  static double data[N * F];
+  static float label[N];
+  unsigned s = 42;
+  for (int i = 0; i < N; ++i) {
+    double t = 0;
+    for (int j = 0; j < F; ++j) {
+      s = s * 1103515245u + 12345u;
+      data[i * F + j] = ((double)(s >> 16 & 0x7fff) / 16384.0) - 1.0;
+      t += data[i * F + j];
+    }
+    label[i] = t > 0 ? 1.0f : 0.0f;
+  }
+  H ds = NULL, bst = NULL;
+  CHECK(LGBM_DatasetCreateFromMat(data, 1, N, F, 1, "max_bin=31", NULL,
+                                  &ds));
+  CHECK(LGBM_DatasetSetField(ds, "label", label, N, 0));
+  CHECK(LGBM_BoosterCreate(ds,
+      "objective=binary num_leaves=7 min_data_in_leaf=5 verbosity=-1",
+      &bst));
+  int fin = 0;
+  for (int i = 0; i < 5; ++i) CHECK(LGBM_BoosterUpdateOneIter(bst, &fin));
+  static double out[N];
+  long long out_len = 0;
+  CHECK(LGBM_BoosterPredictForMat(bst, data, 1, N, F, 1, 0, 0, -1, "",
+                                  &out_len, out));
+  if (out_len != N) { fprintf(stderr, "bad out_len\n"); return 1; }
+  double pos = 0, neg = 0; int np_ = 0, nn = 0;
+  for (int i = 0; i < N; ++i) {
+    if (label[i] > 0.5) { pos += out[i]; ++np_; } else { neg += out[i]; ++nn; }
+  }
+  if (pos / np_ <= neg / nn) { fprintf(stderr, "no signal\n"); return 1; }
+  CHECK(LGBM_BoosterFree(bst));
+  CHECK(LGBM_DatasetFree(ds));
+  printf("C-API-OK\n");
+  return 0;
+}
+"""
+
+
+@pytest.mark.slow
+def test_capi_external_c_program(tmp_path):
+    """A plain C program (no Python involved on its side) trains and
+    predicts through the shim — the reference's external-tooling
+    contract."""
+    _ensure_built()
+    src = tmp_path / "driver.c"
+    src.write_text(C_DRIVER)
+    exe = tmp_path / "driver"
+    subprocess.run(
+        ["g++", "-x", "c", str(src), "-x", "none", "-o", str(exe),
+         str(SO_PATH), f"-Wl,-rpath,{SO_PATH.parent}"],
+        check=True, capture_output=True)
+    from lightgbm_tpu.hostenv import cpu_child_env
+    env = cpu_child_env()
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run([str(exe)], env=env, capture_output=True,
+                          text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr
+    assert "C-API-OK" in proc.stdout

@@ -169,6 +169,35 @@ def test_rank_xendcg_gradients_sum_zero_per_query():
     assert g[4] == np.min(g[3:])
 
 
+@pytest.mark.parametrize("alpha", [0.1, 0.5, 0.9])
+@pytest.mark.parametrize("weighted", [False, True])
+def test_percentile_renew_traced_matches_host(alpha, weighted):
+    """The fused fast path's traced percentile renewal must agree with the
+    host `_renew_by_percentile` twin on identical inputs."""
+    from lightgbm_tpu.objectives import (_percentile_renew_traced,
+                                         _renew_by_percentile)
+    from lightgbm_tpu.tree import Tree
+    rng = np.random.RandomState(7)
+    n, L = 500, 8
+    residual = rng.randn(n).astype(np.float32)
+    weights = (rng.rand(n).astype(np.float32) + 0.1 if weighted
+               else np.ones(n, np.float32))
+    row_leaf = rng.randint(0, L - 1, n)  # leaf L-1 left empty on purpose
+    mask = (rng.rand(n) < 0.8).astype(np.float32)
+    tree = Tree(L)
+    tree.leaf_value = rng.randn(L)
+    orig_empty = float(tree.leaf_value[L - 1])
+    host = _renew_by_percentile(tree, residual.astype(np.float64), weights,
+                                row_leaf, mask, alpha)
+    dev = np.asarray(_percentile_renew_traced(
+        jnp.zeros(L, jnp.float32).at[L - 1].set(orig_empty),
+        jnp.asarray(row_leaf), jnp.asarray(residual), jnp.asarray(weights),
+        jnp.asarray(mask), alpha))
+    np.testing.assert_allclose(dev[:L - 1], host.leaf_value[:L - 1],
+                               rtol=1e-5, atol=1e-6)
+    assert dev[L - 1] == pytest.approx(orig_empty)  # empty leaf untouched
+
+
 def test_renew_tree_output_l1():
     """L1 leaf values become medians of residuals (ref: RenewTreeOutput)."""
     from lightgbm_tpu.tree import Tree
