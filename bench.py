@@ -207,7 +207,7 @@ def _measure():
     dt = (time.time() - t0) / iters
 
     iters_per_sec = 1.0 / dt
-    unit = "iters/sec (N=%d, 255 leaves, 63 bins" % n
+    unit = "iters/sec (N=%d, 255 leaves, 63 bins, bin=%.1fs" % (n, bin_time)
     if platform != "tpu":
         unit += ", platform=%s" % platform
     unit += ")"

@@ -305,12 +305,10 @@ class BinMapper:
         if binned.size:
             bc = np.bincount(binned, minlength=self.num_bins)
             self.most_freq_bin = int(bc.argmax())
-        self.is_trivial = self._count_effective_bins(values) <= 1
-
-    def _count_effective_bins(self, values: np.ndarray) -> int:
-        if values.size == 0:
-            return 1
-        return int(len(np.unique(self.transform(values))))
+            effective = int(np.count_nonzero(bc))
+        else:
+            effective = 1
+        self.is_trivial = effective <= 1
 
     def _fit_categorical(self, clean: np.ndarray, na_cnt: int, max_bin: int,
                          min_data_in_bin: int, use_missing: bool) -> None:

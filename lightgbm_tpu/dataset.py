@@ -52,13 +52,17 @@ def _transform_all(data: np.ndarray, mappers: List[BinMapper],
     done = set()
     if len(numeric) > 1 and n * len(numeric) >= 65536:
         from . import native as _native
-        # single Fortran-order materialization (the C++ kernel reads
-        # column-major)
-        sub = np.asfortranarray(data[:, [used[j] for j in numeric]],
-                                np.float64)
+        cols = [used[j] for j in numeric]
+        if cols == list(range(data.shape[1])) and (
+                data.flags["C_CONTIGUOUS"] or data.flags["F_CONTIGUOUS"]):
+            sub = data  # all columns numeric+used: zero-copy into the kernel
+        else:
+            sub = data[:, cols]  # C-order gather, original dtype
         out = _native.transform_matrix(sub, [mappers[j] for j in numeric],
                                        dtype)
         if out is not None:
+            if len(numeric) == len(used):
+                return out  # [F_used, N] already — skip the copy
             for k, j in enumerate(numeric):
                 bins_fm[j] = out[k]
             done = set(numeric)

@@ -29,17 +29,61 @@ _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
+_BUILDINFO_PATH = _LIB_PATH + ".buildinfo"
+
+
+def _host_isa_tag() -> str:
+    """A stable fingerprint of this host's ISA: the cached -march=native
+    .so must be rebuilt when the package directory moves to a CPU with
+    different features (NFS homes, copied venvs), or it would SIGILL."""
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith("flags"):
+                    import hashlib
+                    return hashlib.sha256(
+                        " ".join(sorted(line.split()[2:])).encode()
+                    ).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+    return platform.machine()
+
+
 def _build() -> bool:
     if not os.path.exists(_SRC_PATH):
         return False
     try:
-        subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-             _SRC_PATH, "-o", _LIB_PATH],
-            check=True, capture_output=True, timeout=120)
+        args = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
+                "-march=native", _SRC_PATH, "-o", _LIB_PATH]
+        try:
+            subprocess.run(args, check=True, capture_output=True,
+                           timeout=120)
+        except subprocess.CalledProcessError as exc:
+            # retry portably only when the flag itself was the problem
+            msg = (exc.stderr or b"").decode(errors="replace")
+            if "march" not in msg and "arch" not in msg:
+                return False
+            subprocess.run([a for a in args if a != "-march=native"],
+                           check=True, capture_output=True, timeout=120)
+        with open(_BUILDINFO_PATH, "w") as fh:
+            fh.write(_host_isa_tag())
         return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return False
+
+
+def _cached_lib_stale() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    if os.path.exists(_SRC_PATH) and \
+            os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH):
+        return True
+    try:
+        with open(_BUILDINFO_PATH) as fh:
+            return fh.read().strip() != _host_isa_tag()
+    except OSError:
+        return True  # unknown provenance: rebuild rather than risk SIGILL
 
 
 def get_lib() -> Optional[ctypes.CDLL]:
@@ -54,9 +98,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("LIGHTGBM_TPU_NO_NATIVE"):
             return None
-        if not os.path.exists(_LIB_PATH) or (
-                os.path.exists(_SRC_PATH) and
-                os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)):
+        if _cached_lib_stale():
             if not _build() and not os.path.exists(_LIB_PATH):
                 return None
         try:
@@ -91,6 +133,15 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+        try:
+            lib.LGT_TransformMatrix2.restype = None
+            lib.LGT_TransformMatrix2.argtypes = [
+                ctypes.c_void_p, ctypes.c_int32, ctypes.c_int32,
+                ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+        except AttributeError:
+            pass  # stale pre-v2 .so; transform_matrix falls back
         _lib = lib
         return _lib
 
@@ -159,16 +210,19 @@ def transform_column(values: np.ndarray, bounds: np.ndarray,
 
 
 def transform_matrix(data: np.ndarray, mappers, dtype) -> Optional[np.ndarray]:
-    """Bin all numerical columns at once (threaded over features).
-    `data` is [N, F_used] with columns already gathered; any categorical
-    mapper columns must be handled by the caller. Returns [F_used, N]."""
+    """Bin all numerical columns at once (threaded). `data` is
+    [N, F_used] with columns already gathered; any categorical mapper
+    columns must be handled by the caller. Returns [F_used, N].
+
+    The v2 kernel consumes float32/float64 in row- or column-major order
+    directly — at Higgs scale the old mandatory float64 column-major
+    copy cost more than the binning itself."""
     lib = get_lib()
     if lib is None:
         return None
     n, f = data.shape
     if any(m.is_categorical or m.bin_upper_bound is None for m in mappers):
         return None
-    data_cm = np.asfortranarray(data, np.float64)  # no-op if already F-order
     offsets = np.zeros(f + 1, np.int64)
     for j, m in enumerate(mappers):
         offsets[j + 1] = offsets[j] + len(m.bin_upper_bound)
@@ -179,6 +233,18 @@ def transform_matrix(data: np.ndarray, mappers, dtype) -> Optional[np.ndarray]:
     nbins = np.array([m.num_bins for m in mappers], np.int32)
     elem = np.dtype(dtype).itemsize
     out = np.empty((f, n), dtype=dtype)
+    if hasattr(lib, "LGT_TransformMatrix2"):
+        if data.dtype not in (np.float32, np.float64) or not (
+                data.flags["C_CONTIGUOUS"] or data.flags["F_CONTIGUOUS"]):
+            data = np.ascontiguousarray(data, np.float64)
+        row_major = 1 if data.flags["C_CONTIGUOUS"] else 0
+        lib.LGT_TransformMatrix2(
+            data.ctypes.data, int(data.dtype == np.float32), row_major,
+            n, f, bounds_flat.ctypes.data, offsets.ctypes.data,
+            missing.ctypes.data, default.ctypes.data, nbins.ctypes.data,
+            elem, out.ctypes.data)
+        return out
+    data_cm = np.asfortranarray(data, np.float64)  # no-op if already F-order
     lib.LGT_TransformMatrix(
         data_cm.ctypes.data, n, f, bounds_flat.ctypes.data,
         offsets.ctypes.data, missing.ctypes.data, default.ctypes.data,
