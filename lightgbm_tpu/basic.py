@@ -532,6 +532,102 @@ class Booster:
                    ) -> dict:
         return dump_model_to_json(self._gbdt, num_iteration, start_iteration)
 
+    # ------------------------------------------------------------------
+    def attr(self, key: str):
+        """Booster attribute by name, or None (ref: Booster.attr
+        python-package basic.py / LGBM_BoosterGetAttr)."""
+        return getattr(self, "_attr", {}).get(key)
+
+    def set_attr(self, **kwargs) -> "Booster":
+        """Set string attributes; a None value deletes the key
+        (ref: Booster.set_attr / LGBM_BoosterSetAttr)."""
+        store = getattr(self, "_attr", None)
+        if store is None:
+            store = self._attr = {}
+        for key, value in kwargs.items():
+            if value is None:
+                store.pop(key, None)
+            else:
+                if not isinstance(value, str):
+                    raise LightGBMError(
+                        "Only string values are accepted as attributes")
+                store[key] = value
+        return self
+
+    def trees_to_dataframe(self):
+        """The fitted model as one pandas row per node, with the
+        reference's column schema (ref: Booster.trees_to_dataframe,
+        python-package basic.py:3775)."""
+        import pandas as pd
+
+        if self.num_trees() == 0:
+            raise LightGBMError(
+                "There are no trees in this Booster and thus nothing "
+                "to parse")
+        feature_names = self.feature_name()
+        rows = []
+
+        def walk(node, tree_index, depth, parent):
+            is_split = "split_index" in node
+            node_id = (f"{tree_index}-S{node['split_index']}" if is_split
+                       else f"{tree_index}-L{node.get('leaf_index', 0)}")
+            rec = {
+                "tree_index": tree_index,
+                "node_depth": depth,
+                "node_index": node_id,
+                "left_child": None,
+                "right_child": None,
+                "parent_index": parent,
+                "split_feature": None,
+                "split_gain": np.nan,
+                "threshold": np.nan,
+                "decision_type": None,
+                "missing_direction": None,
+                "missing_type": None,
+                "value": node.get("leaf_value"),
+                "weight": node.get("leaf_weight"),
+                "count": node.get("leaf_count"),
+            }
+            if is_split:
+                f = node["split_feature"]
+                rec.update(
+                    split_feature=(feature_names[f]
+                                   if f < len(feature_names)
+                                   else f"Column_{f}"),
+                    split_gain=node["split_gain"],
+                    threshold=node["threshold"],
+                    decision_type=node["decision_type"],
+                    missing_direction=("left" if node["default_left"]
+                                       else "right"),
+                    missing_type=node["missing_type"],
+                    value=node["internal_value"],
+                    weight=node["internal_weight"],
+                    count=node["internal_count"],
+                )
+            rows.append(rec)
+            if is_split:
+                left, right = node["left_child"], node["right_child"]
+
+                def child_id(c):
+                    return (f"{tree_index}-S{c['split_index']}"
+                            if "split_index" in c
+                            else f"{tree_index}-L{c.get('leaf_index', 0)}")
+
+                rec["left_child"] = child_id(left)
+                rec["right_child"] = child_id(right)
+                walk(left, tree_index, depth + 1, node_id)
+                walk(right, tree_index, depth + 1, node_id)
+
+        if self._loaded is not None:
+            # text-loaded models carry Tree objects directly
+            tree_infos = [t.to_json(i)
+                          for i, t in enumerate(self._loaded.trees)]
+        else:
+            tree_infos = self.dump_model()["tree_info"]
+        for t in tree_infos:
+            walk(t["tree_structure"], t["tree_index"], 1, None)
+        return pd.DataFrame(rows)
+
     def feature_importance(self, importance_type: str = "split",
                            iteration: int = -1) -> np.ndarray:
         if self._loaded is not None:

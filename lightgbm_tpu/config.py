@@ -437,8 +437,13 @@ class Config:
     # learner._wave_schedule — so early splits stay near-exact; the cap
     # only bounds the LATE waves. Default 42 = the multi-leaf kernel's
     # slot count (128 MXU lanes // 3 channels); ~13 full-data histogram
-    # passes per 255-leaf tree instead of 254, at quality parity
-    # (tests/test_waved.py).
+    # passes per 255-leaf tree instead of 254, at quality parity on
+    # binary/regression/ranking (tests/test_waved.py; parity-gated vs
+    # the reference in tests/test_consistency.py's waved tier). Known
+    # envelope: multiclass softmax logloss CALIBRATION drifts (~+0.13
+    # on the reference multiclass example at 31 leaves) while auc_mu
+    # ordering stays better than the reference; set tpu_wave_max=0 for
+    # exact reference-grade multiclass calibration.
     tpu_wave_max: int = 42
     # MXU precision of the histogram one-hot contraction: "default" =
     # single bf16 pass with f32 accumulation (the one-hot operand is

@@ -18,24 +18,17 @@ from typing import Optional, Tuple
 
 import numpy as np
 
-_LIB_NAME = "liblgbm_tpu_native.so"
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
-_LIB_PATH = os.path.join(_PKG_DIR, _LIB_NAME)
 _SRC_PATH = os.path.join(os.path.dirname(_PKG_DIR), "native", "src",
                          "lgbm_tpu_native.cpp")
 
-_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
-_tried = False
-
-
-_BUILDINFO_PATH = _LIB_PATH + ".buildinfo"
-
 
 def _host_isa_tag() -> str:
-    """A stable fingerprint of this host's ISA: the cached -march=native
-    .so must be rebuilt when the package directory moves to a CPU with
-    different features (NFS homes, copied venvs), or it would SIGILL."""
+    """A stable fingerprint of this host's ISA. The library filename is
+    tagged with it, so a package directory shared between CPUs with
+    different features (NFS homes, copied venvs) keeps one -march=native
+    build per host class instead of thrashing one file (and never loads
+    a library containing another host's illegal instructions)."""
     try:
         with open("/proc/cpuinfo") as fh:
             for line in fh:
@@ -50,12 +43,23 @@ def _host_isa_tag() -> str:
     return platform.machine()
 
 
+_LIB_NAME = f"liblgbm_tpu_native.{_host_isa_tag()}.so"
+_LIB_PATH = os.path.join(_PKG_DIR, _LIB_NAME)
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
 def _build() -> bool:
     if not os.path.exists(_SRC_PATH):
         return False
+    # build to a unique temp path, then atomically install: a concurrent
+    # importer never dlopens a half-written library
+    tmp_path = f"{_LIB_PATH}.build.{os.getpid()}"
     try:
         args = ["g++", "-O3", "-std=c++17", "-fPIC", "-shared", "-pthread",
-                "-march=native", _SRC_PATH, "-o", _LIB_PATH]
+                "-march=native", _SRC_PATH, "-o", tmp_path]
         try:
             subprocess.run(args, check=True, capture_output=True,
                            timeout=120)
@@ -66,24 +70,23 @@ def _build() -> bool:
                 return False
             subprocess.run([a for a in args if a != "-march=native"],
                            check=True, capture_output=True, timeout=120)
-        with open(_BUILDINFO_PATH, "w") as fh:
-            fh.write(_host_isa_tag())
+        os.replace(tmp_path, _LIB_PATH)
         return True
     except (subprocess.SubprocessError, FileNotFoundError, OSError):
         return False
+    finally:
+        try:
+            if os.path.exists(tmp_path):
+                os.remove(tmp_path)
+        except OSError:
+            pass
 
 
 def _cached_lib_stale() -> bool:
     if not os.path.exists(_LIB_PATH):
         return True
-    if os.path.exists(_SRC_PATH) and \
-            os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH):
-        return True
-    try:
-        with open(_BUILDINFO_PATH) as fh:
-            return fh.read().strip() != _host_isa_tag()
-    except OSError:
-        return True  # unknown provenance: rebuild rather than risk SIGILL
+    return os.path.exists(_SRC_PATH) and \
+        os.path.getmtime(_SRC_PATH) > os.path.getmtime(_LIB_PATH)
 
 
 def get_lib() -> Optional[ctypes.CDLL]:

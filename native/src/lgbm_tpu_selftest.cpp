@@ -1,0 +1,161 @@
+// Sanitizer self-test for the native host runtime: exercises the
+// parser, bound finding, and every transform entry point (threaded, all
+// dtype/order combinations) so an address/UB-sanitized build has real
+// traffic to check. The reference ships the analogous tier as
+// USE_SANITIZER + cpp_tests (ref: CMakeLists.txt:11-19,
+// cmake/Sanitizer.cmake); here: `make -C native check-sanitize`.
+//
+// Exit code 0 = all assertions passed and no sanitizer report fired.
+
+#include <cassert>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+extern "C" {
+void* LGT_ParseFile(const char* path, int label_idx, int has_header);
+int64_t LGT_ParseNumRows(void* h);
+int32_t LGT_ParseNumCols(void* h);
+const char* LGT_ParseError(void* h);
+void LGT_ParseCopy(void* h, double* data_out, double* label_out);
+void LGT_ParseFree(void* h);
+int32_t LGT_FindNumericalBounds(const double* values, int64_t n,
+                                int max_bin, int min_data_in_bin,
+                                int missing_type, int zero_as_missing,
+                                double* bounds_out);
+void LGT_TransformColumn(const double* values, int64_t n,
+                         const double* bounds, int32_t num_bounds,
+                         int missing_type, int32_t default_bin,
+                         int32_t num_bins, int32_t* bins_out);
+void LGT_TransformMatrix(const double* data_cm, int64_t n, int32_t f,
+                         const double* bounds_flat,
+                         const int64_t* bounds_offsets,
+                         const int32_t* missing_types,
+                         const int32_t* default_bins,
+                         const int32_t* num_bins, int elem_size,
+                         void* bins_out_fm);
+void LGT_TransformMatrix2(const void* data, int32_t is_f32,
+                          int32_t row_major, int64_t n, int32_t f,
+                          const double* bounds_flat,
+                          const int64_t* bounds_offsets,
+                          const int32_t* missing_types,
+                          const int32_t* default_bins,
+                          const int32_t* num_bins, int elem_size,
+                          void* bins_out_fm);
+int32_t LGT_Version();
+}
+
+namespace {
+
+double Rand01(uint64_t* s) {
+  *s = *s * 6364136223846793005ULL + 1442695040888963407ULL;
+  return static_cast<double>((*s >> 11) & ((1ULL << 53) - 1)) /
+         static_cast<double>(1ULL << 53);
+}
+
+void TestParser() {
+  const char* path = "/tmp/lgt_selftest.csv";
+  FILE* fp = std::fopen(path, "w");
+  std::fprintf(fp, "1,0.5,2.25,-1\n0,1.5,,3\n1,-0.25,7.5,0\n");
+  std::fclose(fp);
+  void* h = LGT_ParseFile(path, 0, 0);
+  assert(LGT_ParseError(h) == nullptr);
+  assert(LGT_ParseNumRows(h) == 3);
+  assert(LGT_ParseNumCols(h) == 3);
+  std::vector<double> data(9), label(3);
+  LGT_ParseCopy(h, data.data(), label.data());
+  LGT_ParseFree(h);
+  assert(label[0] == 1 && label[1] == 0 && label[2] == 1);
+  assert(data[0] == 0.5 && std::isnan(data[4]));
+  std::remove(path);
+}
+
+void TestBinning() {
+  const int64_t n = 200000;
+  const int32_t f = 7;
+  uint64_t seed = 7;
+  std::vector<double> col(n);
+  for (int64_t i = 0; i < n; ++i) {
+    col[i] = Rand01(&seed) * 10.0 - 5.0;
+    if (i % 97 == 0) col[i] = NAN;
+    if (i % 31 == 0) col[i] = 0.0;
+  }
+  std::vector<double> bounds(66);
+  int32_t nb = LGT_FindNumericalBounds(col.data(), n, 63, 3,
+                                       /*kMissingNan=*/2, 0, bounds.data());
+  assert(nb > 1 && nb <= 65);
+  std::vector<int32_t> bins(n);
+  LGT_TransformColumn(col.data(), n, bounds.data(), nb, 2, 0, nb + 1,
+                      bins.data());
+  for (int64_t i = 0; i < n; ++i) assert(bins[i] >= 0 && bins[i] <= nb);
+
+  // matrix paths: v1 (f64 col-major) and v2 (all dtype/order combos)
+  // must agree bin-for-bin
+  // f32-representable values: a real float32 caller's data widens to
+  // these exact doubles, so every dtype/order combination must agree
+  // bin-for-bin
+  std::vector<double> mat_rm(n * f);
+  for (int64_t i = 0; i < n * f; ++i) {
+    mat_rm[i] = static_cast<float>(Rand01(&seed) * 8.0 - 4.0);
+    if (i % 113 == 0) mat_rm[i] = NAN;
+  }
+  std::vector<double> mat_cm(n * f);
+  std::vector<float> mat_rm32(n * f), mat_cm32(n * f);
+  for (int64_t i = 0; i < n; ++i) {
+    for (int32_t j = 0; j < f; ++j) {
+      double v = mat_rm[i * f + j];
+      mat_cm[j * n + i] = v;
+      mat_rm32[i * f + j] = static_cast<float>(v);
+      mat_cm32[j * n + i] = static_cast<float>(v);
+    }
+  }
+  std::vector<int64_t> offs(f + 1, 0);
+  std::vector<double> bflat;
+  std::vector<int32_t> miss(f), defb(f), nbins(f);
+  for (int32_t j = 0; j < f; ++j) {
+    std::vector<double> b(66);
+    int32_t cnt = LGT_FindNumericalBounds(mat_cm.data() + j * n, n, 63, 3,
+                                          2, 0, b.data());
+    assert(cnt > 0);
+    bflat.insert(bflat.end(), b.begin(), b.begin() + cnt);
+    offs[j + 1] = offs[j] + cnt;
+    miss[j] = 2;
+    defb[j] = 0;
+    nbins[j] = cnt + 1;
+  }
+  std::vector<uint8_t> out_v1(f * n), out(f * n);
+  LGT_TransformMatrix(mat_cm.data(), n, f, bflat.data(), offs.data(),
+                      miss.data(), defb.data(), nbins.data(), 1,
+                      out_v1.data());
+  struct Case {
+    const void* data;
+    int32_t is_f32, row_major;
+  } cases[] = {{mat_rm.data(), 0, 1},
+               {mat_cm.data(), 0, 0},
+               {mat_rm32.data(), 1, 1},
+               {mat_cm32.data(), 1, 0}};
+  for (const Case& c : cases) {
+    std::memset(out.data(), 0xFF, out.size());
+    LGT_TransformMatrix2(c.data, c.is_f32, c.row_major, n, f, bflat.data(),
+                         offs.data(), miss.data(), defb.data(),
+                         nbins.data(), 1, out.data());
+    assert(std::memcmp(out.data(), out_v1.data(), out.size()) == 0);
+  }
+  // empty input must be a no-op, not a crash
+  LGT_TransformMatrix2(mat_rm.data(), 0, 1, 0, f, bflat.data(), offs.data(),
+                       miss.data(), defb.data(), nbins.data(), 1,
+                       out.data());
+}
+
+}  // namespace
+
+int main() {
+  assert(LGT_Version() >= 2);
+  TestParser();
+  TestBinning();
+  std::printf("native selftest OK\n");
+  return 0;
+}

@@ -1,0 +1,129 @@
+"""Booster attribute/introspection surface + native sanitizer tier
+(ref: python-package basic.py attr/set_attr/trees_to_dataframe:3775;
+sanitizer tier ref: CMakeLists.txt:11-19 USE_SANITIZER + cpp_tests)."""
+
+import shutil
+import subprocess
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from conftest import make_binary
+
+import lightgbm_tpu as lgb
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def booster():
+    X, y = make_binary(400, 5)
+    return lgb.train({"objective": "binary", "num_leaves": 7,
+                      "min_data_in_leaf": 5, "verbosity": -1},
+                     lgb.Dataset(X, label=y), num_boost_round=3)
+
+
+class TestAttributes:
+    def test_set_get_delete(self, booster):
+        assert booster.attr("note") is None
+        booster.set_attr(note="hello", other="1")
+        assert booster.attr("note") == "hello"
+        assert booster.attr("other") == "1"
+        booster.set_attr(note=None)
+        assert booster.attr("note") is None
+        assert booster.attr("other") == "1"
+
+    def test_non_string_rejected(self, booster):
+        with pytest.raises(lgb.basic.LightGBMError):
+            booster.set_attr(bad=42)
+
+
+class TestTreesToDataframe:
+    def test_schema_and_consistency(self, booster):
+        df = booster.trees_to_dataframe()
+        expected = ["tree_index", "node_depth", "node_index", "left_child",
+                    "right_child", "parent_index", "split_feature",
+                    "split_gain", "threshold", "decision_type",
+                    "missing_direction", "missing_type", "value", "weight",
+                    "count"]
+        assert list(df.columns) == expected
+        assert df["tree_index"].nunique() == booster.num_trees()
+        # every tree: one root at depth 1 with no parent
+        roots = df[df["node_depth"] == 1]
+        assert len(roots) == booster.num_trees()
+        assert roots["parent_index"].isna().all()
+        # split rows have children that exist; leaf rows have none
+        splits = df[df["left_child"].notna()]
+        leaves = df[df["left_child"].isna()]
+        ids = set(df["node_index"])
+        assert set(splits["left_child"]).issubset(ids)
+        assert set(splits["right_child"]).issubset(ids)
+        assert leaves["split_feature"].isna().all()
+        # node counts: internal = leaves - 1 per tree
+        for t, g in df.groupby("tree_index"):
+            n_leaf = g["left_child"].isna().sum()
+            assert len(g) == 2 * n_leaf - 1
+        # root count equals the training rows
+        assert (roots["count"] == 400).all()
+
+    def test_text_loaded_model(self, booster, tmp_path):
+        """Boosters loaded from a model file parse too (the reference's
+        most common inspection use case)."""
+        path = tmp_path / "model.txt"
+        booster.save_model(str(path))
+        loaded = lgb.Booster(model_file=str(path))
+        df_live = booster.trees_to_dataframe()
+        df_loaded = loaded.trees_to_dataframe()
+        assert len(df_loaded) == len(df_live)
+        assert list(df_loaded["node_index"]) == list(df_live["node_index"])
+        np.testing.assert_allclose(
+            df_loaded["value"].astype(float),
+            df_live["value"].astype(float), rtol=1e-5, atol=1e-7)
+
+    def test_empty_booster_raises(self):
+        X, y = make_binary(100, 4)
+        bst = lgb.Booster({"objective": "binary", "verbosity": -1},
+                          lgb.Dataset(X, label=y))
+        with pytest.raises(lgb.basic.LightGBMError):
+            bst.trees_to_dataframe()
+
+
+@pytest.mark.slow
+def test_native_sanitizer_tier():
+    """`make -C native check-sanitize` builds the native runtime with
+    ASan/UBSan and runs the threaded self-test — the reference's
+    USE_SANITIZER tier."""
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    proc = subprocess.run(["make", "-C", str(REPO / "native"),
+                           "check-sanitize"], capture_output=True,
+                          text=True, timeout=600)
+    err = proc.stderr or ""
+    # skip ONLY on a missing sanitizer runtime — an actual
+    # AddressSanitizer/UBSan report must FAIL, not skip
+    missing_runtime = ("cannot find -lasan" in err
+                       or "cannot find -lubsan" in err
+                       or "unrecognized command-line option" in err)
+    if proc.returncode != 0 and missing_runtime and \
+            "AddressSanitizer" not in err and "runtime error:" not in err:
+        pytest.skip("toolchain lacks sanitizer runtime")
+    assert proc.returncode == 0, err
+    assert "native selftest OK" in proc.stdout
+
+
+def test_training_produces_no_nans_under_debug():
+    """JAX debug tier: a representative fused training run under
+    jax_debug_nans — any NaN materializing in the per-iteration program
+    raises instead of silently propagating."""
+    import jax
+    X, y = make_binary(300, 5)
+    jax.config.update("jax_debug_nans", True)
+    try:
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=3)
+        pred = bst.predict(X)
+    finally:
+        jax.config.update("jax_debug_nans", False)
+    assert np.isfinite(pred).all()
