@@ -93,6 +93,11 @@ def main():
     attempts = []
     if _relay_up():
         attempts.append((requested, "axon", budget))
+        # same-size retry first: a crashed/timed-out attempt leaves its
+        # finished compilations in .jax_cache, so the retry mostly just
+        # measures (a smaller row count would compile a DIFFERENT
+        # program and gain nothing) — hence the halved budget
+        attempts.append((requested, "axon", budget / 2))
         if requested > 1_000_000:
             attempts.append((1_000_000, "axon", budget / 2))
     else:
@@ -107,6 +112,7 @@ def main():
     import tempfile
     queue = list(attempts)
     i = 0
+    hangs = 0
     while queue:
         rows, platform, timeout = queue.pop(0)
         with tempfile.NamedTemporaryFile("r", suffix=".json") as tf:
@@ -120,10 +126,17 @@ def main():
         i += 1
         if platform == "axon":
             if rc == -1:
-                # the TPU path HUNG (wedged relay) rather than crashed:
-                # further TPU attempts would hang the same way — go
-                # straight to the CPU fallback
-                queue = [a for a in queue if a[1] != "axon"]
+                # the TPU attempt timed out. Once could be a too-slow
+                # first compile (the retry then rides .jax_cache); twice
+                # means the relay is wedged and every further TPU
+                # attempt would hang the same way. The abandoned child
+                # may still hold the single-tenant relay — give it time
+                # to finish dying before the retry reconnects.
+                hangs += 1
+                if hangs >= 2:
+                    queue = [a for a in queue if a[1] != "axon"]
+                else:
+                    time.sleep(90)
             else:
                 time.sleep(20)  # give a flapping relay a moment
 
