@@ -81,10 +81,14 @@ def test_enable_bundle_bundles_sparse_features():
     assert binned.bins_fm.shape[0] < binned.num_features
 
 
-def test_monotone_method_advanced_warns(captured_log):
+def test_monotone_method_advanced_no_warning(captured_log):
+    """intermediate/advanced are implemented (exact pairwise leaf-box
+    bounds — see ops/split.py compute_box_bounds), so requesting them
+    must NOT warn a downgrade anymore."""
     _train({"monotone_constraints": [1, 0, 0, 0, 0, 0, 0, 0],
             "monotone_constraints_method": "advanced"})
-    assert any("monotone_constraints_method" in m for m in captured_log.msgs)
+    assert not any("monotone_constraints_method" in m
+                   for m in captured_log.msgs)
 
 
 def test_set_network_warns(captured_log):
