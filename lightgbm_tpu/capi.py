@@ -87,6 +87,57 @@ def dataset_create_from_mat(data_ptr: int, data_type: int, nrow: int,
     return _new_handle(ds)
 
 
+def _csr_from_ptrs(indptr_ptr: int, indptr_type: int, indices_ptr: int,
+                   data_ptr: int, data_type: int, nindptr: int,
+                   nelem: int, num_col: int):
+    from scipy import sparse
+    indptr = _array_from_ptr(indptr_ptr, nindptr, indptr_type)
+    indices = _array_from_ptr(indices_ptr, nelem, 2)  # int32
+    data = _array_from_ptr(data_ptr, nelem, data_type)
+    return sparse.csr_matrix(
+        (np.asarray(data, np.float64), indices, indptr),
+        shape=(nindptr - 1, num_col))
+
+
+def dataset_create_from_csr(indptr_ptr: int, indptr_type: int,
+                            indices_ptr: int, data_ptr: int,
+                            data_type: int, nindptr: int, nelem: int,
+                            num_col: int, parameters: str,
+                            reference: int) -> int:
+    """(ref: LGBM_DatasetCreateFromCSR c_api.cpp:1311) — feeds the
+    densification-free sparse ingestion path."""
+    csr = _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                         data_type, nindptr, nelem, num_col)
+    ref = _get(reference) if reference else None
+    ds = Dataset(csr, reference=ref, params=_parse_params(parameters))
+    return _new_handle(ds)
+
+
+def _predict_into(bst, matrix, predict_type: int, start_iteration: int,
+                  num_iteration: int, out_ptr: int) -> int:
+    """Shared predict dispatch + result write for the dense and CSR
+    entry points."""
+    pred = bst.predict(matrix, start_iteration=start_iteration,
+                       num_iteration=num_iteration,
+                       raw_score=predict_type == _PREDICT_RAW,
+                       pred_leaf=predict_type == _PREDICT_LEAF,
+                       pred_contrib=predict_type == _PREDICT_CONTRIB)
+    return _write_doubles(out_ptr, np.asarray(pred).reshape(-1))
+
+
+def booster_predict_for_csr(handle: int, indptr_ptr: int, indptr_type: int,
+                            indices_ptr: int, data_ptr: int,
+                            data_type: int, nindptr: int, nelem: int,
+                            num_col: int, predict_type: int,
+                            start_iteration: int, num_iteration: int,
+                            out_ptr: int) -> int:
+    """(ref: LGBM_BoosterPredictForCSR c_api.cpp)"""
+    csr = _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                         data_type, nindptr, nelem, num_col)
+    return _predict_into(_get(handle), csr, predict_type, start_iteration,
+                         num_iteration, out_ptr)
+
+
 def dataset_create_from_file(filename: str, parameters: str,
                              reference: int) -> int:
     """(ref: LGBM_DatasetCreateFromFile c_api.cpp:1044)"""
@@ -181,17 +232,12 @@ def booster_predict_for_mat(handle: int, data_ptr: int, data_type: int,
                             predict_type: int, start_iteration: int,
                             num_iteration: int, out_ptr: int) -> int:
     """(ref: LGBM_BoosterPredictForMat c_api.cpp:2558)"""
-    bst = _get(handle)
     flat = _array_from_ptr(data_ptr, nrow * ncol, data_type)
     mat = (flat.reshape(nrow, ncol) if is_row_major
            else flat.reshape(ncol, nrow).T)
-    pred = bst.predict(np.asarray(mat, np.float64),
-                       start_iteration=start_iteration,
-                       num_iteration=num_iteration,
-                       raw_score=predict_type == _PREDICT_RAW,
-                       pred_leaf=predict_type == _PREDICT_LEAF,
-                       pred_contrib=predict_type == _PREDICT_CONTRIB)
-    return _write_doubles(out_ptr, np.asarray(pred).reshape(-1))
+    return _predict_into(_get(handle), np.asarray(mat, np.float64),
+                         predict_type, start_iteration, num_iteration,
+                         out_ptr)
 
 
 def booster_save_model(handle: int, start_iteration: int,

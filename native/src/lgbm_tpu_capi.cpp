@@ -1,7 +1,8 @@
 // C-ABI compatibility shim: a subset of the reference's `LGBM_*` surface
-// (ref: include/LightGBM/c_api.h, 131 functions; this shim covers the ~17
-// that dataset/booster lifecycle harnesses use) backed by the
-// lightgbm_tpu Python framework through an embedded CPython interpreter.
+// (ref: include/LightGBM/c_api.h, 131 functions; this shim covers the 19
+// that dataset/booster lifecycle harnesses use, incl. dense + CSR
+// creation and prediction) backed by the lightgbm_tpu Python framework
+// through an embedded CPython interpreter.
 //
 // Design: every entry point forwards to lightgbm_tpu.capi with raw
 // pointers passed as integers; that module wraps them with ctypes/NumPy
@@ -136,6 +137,29 @@ LGBM_API int LGBM_DatasetCreateFromMat(const void* data, int data_type,
   PyObject* r = Call("dataset_create_from_mat", "(LiiiisL)",
                      (long long)(intptr_t)data, data_type, (int)nrow,
                      (int)ncol, is_row_major, parameters ? parameters : "",
+                     (long long)AsHandleInt(reference));
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetCreateFromCSR(const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col,
+                                       const char* parameters,
+                                       const DatasetHandle reference,
+                                       DatasetHandle* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_create_from_csr", "(LiLLiLLLsL)",
+                     (long long)(intptr_t)indptr, indptr_type,
+                     (long long)(intptr_t)indices,
+                     (long long)(intptr_t)data, data_type,
+                     (long long)nindptr, (long long)nelem,
+                     (long long)num_col, parameters ? parameters : "",
                      (long long)AsHandleInt(reference));
   if (r == nullptr) return -1;
   *out = reinterpret_cast<DatasetHandle>((intptr_t)PyLong_AsLongLong(r));
@@ -311,6 +335,34 @@ LGBM_API int LGBM_BoosterPredictForMat(BoosterHandle handle,
                      (int)ncol, is_row_major, predict_type,
                      start_iteration, num_iteration,
                      (long long)(intptr_t)out_result);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterPredictForCSR(BoosterHandle handle,
+                                       const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int predict_type,
+                                       int start_iteration,
+                                       int num_iteration,
+                                       const char* parameter,
+                                       int64_t* out_len,
+                                       double* out_result) {
+  (void)parameter;
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_predict_for_csr", "(LLiLLiLLLiiiL)",
+                     (long long)AsHandleInt(handle),
+                     (long long)(intptr_t)indptr, indptr_type,
+                     (long long)(intptr_t)indices,
+                     (long long)(intptr_t)data, data_type,
+                     (long long)nindptr, (long long)nelem,
+                     (long long)num_col, predict_type, start_iteration,
+                     num_iteration, (long long)(intptr_t)out_result);
   if (r == nullptr) return -1;
   *out_len = (int64_t)PyLong_AsLongLong(r);
   Py_DECREF(r);

@@ -135,6 +135,63 @@ class TestCApiInProcess:
         _check(lib, lib.LGBM_BoosterFree(bst))
         _check(lib, lib.LGBM_DatasetFree(ds))
 
+    def test_csr_dataset_and_predict(self, lib):
+        """CSR creation + prediction through the C ABI (ref:
+        LGBM_DatasetCreateFromCSR c_api.cpp:1311) must match the dense
+        path on the same data."""
+        from scipy import sparse
+        rng = np.random.RandomState(5)
+        X = rng.randn(400, 8)
+        X[rng.rand(400, 8) < 0.6] = 0.0  # sparse-ish
+        y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+        csr = sparse.csr_matrix(X)
+        indptr = np.ascontiguousarray(csr.indptr, np.int32)
+        indices = np.ascontiguousarray(csr.indices, np.int32)
+        vals = np.ascontiguousarray(csr.data, np.float64)
+
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromCSR(
+            indptr.ctypes.data_as(ctypes.c_void_p), 2,  # INT32
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals.ctypes.data_as(ctypes.c_void_p), 1,  # FLOAT64
+            ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+            ctypes.c_int64(8), b"max_bin=63", None, ctypes.byref(ds)))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+        assert n.value == 400
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(400), 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=15 min_data_in_leaf=5 "
+                b"verbosity=-1", ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(8):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst,
+                                                      ctypes.byref(fin)))
+        out_csr = (ctypes.c_double * 400)()
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForCSR(
+            bst, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+            ctypes.c_int64(8), 1, 0, -1, b"",
+            ctypes.byref(out_len), out_csr))
+        assert out_len.value == 400
+        X64 = np.ascontiguousarray(X, np.float64)
+        out_dense = (ctypes.c_double * 400)()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, X64.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(400), ctypes.c_int32(8), 1, 1, 0, -1, b"",
+            ctypes.byref(out_len), out_dense))
+        np.testing.assert_allclose(np.asarray(out_csr[:400]),
+                                   np.asarray(out_dense[:400]),
+                                   rtol=1e-6, atol=1e-7)
+        _check(lib, lib.LGBM_BoosterFree(bst))
+        _check(lib, lib.LGBM_DatasetFree(ds))
+
     def test_error_reporting(self, lib):
         bst = ctypes.c_void_p(0)
         fin = ctypes.c_int()
