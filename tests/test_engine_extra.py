@@ -1,0 +1,131 @@
+"""Engine semantics the reference pins in its 4.9k-LoC test_engine.py
+that weren't yet covered here: prediction iteration slicing, early
+stopping min_delta, unseen categoricals, importance types, init_score
+continuation (ref: tests/python_package_test/test_engine.py)."""
+
+import numpy as np
+
+from conftest import make_binary, make_multiclass, make_regression
+
+import lightgbm_tpu as lgb
+
+
+def _booster(params=None, rounds=12, n=600):
+    X, y = make_binary(n)
+    p = {"objective": "binary", "num_leaves": 15, "min_data_in_leaf": 5,
+         "verbosity": -1, **(params or {})}
+    return lgb.train(p, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds), X, y
+
+
+class TestPredictSlicing:
+    def test_num_iteration_prefix(self):
+        """predict(num_iteration=k) equals the raw-score sum of the
+        first k trees (ref: LGBM_BoosterPredictForMat num_iteration)."""
+        bst, X, _y = _booster()
+        full = bst.predict(X, raw_score=True)
+        half = bst.predict(X, raw_score=True, num_iteration=6)
+        assert not np.allclose(full, half)
+        # rebuild the prefix sum from the model dump
+        from lightgbm_tpu.model_io import load_model_from_string
+        prefix = load_model_from_string(
+            bst.model_to_string(num_iteration=6))
+        np.testing.assert_allclose(
+            half, np.asarray(prefix.predict_raw(X)).reshape(-1),
+            rtol=1e-5, atol=1e-6)
+
+    def test_start_iteration_suffix(self):
+        bst, X, _y = _booster()
+        full = bst.predict(X, raw_score=True)
+        head = bst.predict(X, raw_score=True, num_iteration=4)
+        tail = bst.predict(X, raw_score=True, start_iteration=4,
+                           num_iteration=-1)
+        np.testing.assert_allclose(head + tail, full, rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestEarlyStoppingMinDelta:
+    def _run(self, min_delta):
+        X, y = make_binary(900, seed=3)
+        Xt, yt = X[:600], y[:600]
+        Xv, yv = X[600:], y[600:]
+        ds = lgb.Dataset(Xt, label=yt)
+        bst = lgb.train(
+            {"objective": "binary", "num_leaves": 31, "learning_rate":
+             0.02, "min_data_in_leaf": 5, "metric": "binary_logloss",
+             "verbosity": -1},
+            ds, num_boost_round=60,
+            valid_sets=[lgb.Dataset(Xv, label=yv, reference=ds)],
+            callbacks=[lgb.early_stopping(5, min_delta=min_delta,
+                                          verbose=False)])
+        return bst.best_iteration
+
+    def test_min_delta_stops_earlier(self):
+        """A large min_delta must stop no later than min_delta=0
+        (ref: callback.py early_stopping min_delta)."""
+        loose = self._run(0.0)
+        strict = self._run(0.05)
+        assert strict <= loose
+        assert strict < 60
+
+
+class TestCategoricalEdge:
+    def test_unseen_category_predicts(self):
+        rng = np.random.RandomState(0)
+        n = 600
+        cat = rng.randint(0, 4, n).astype(np.float64)
+        X = np.column_stack([cat, rng.randn(n)])
+        y = (cat == 2).astype(np.float64) * 2 + 0.1 * rng.randn(n)
+        bst = lgb.train({"objective": "regression", "num_leaves": 7,
+                         "min_data_in_leaf": 5, "verbosity": -1},
+                        lgb.Dataset(X, label=y,
+                                    categorical_feature=[0]),
+                        num_boost_round=10)
+        Xq = np.array([[99.0, 0.0], [2.0, 0.0]])  # 99 never seen
+        pred = bst.predict(Xq)
+        assert np.isfinite(pred).all()
+        # the unseen category must not land in category 2's leaf
+        assert abs(pred[0] - pred[1]) > 0.5
+
+
+class TestImportanceTypes:
+    def test_split_and_gain(self):
+        bst, X, _y = _booster()
+        split = bst.feature_importance("split")
+        gain = bst.feature_importance("gain")
+        assert split.shape == gain.shape == (X.shape[1],)
+        assert split.sum() > 0 and gain.sum() > 0
+        assert np.all(split == split.astype(int))  # counts
+        assert np.all(gain >= 0)
+        # features never split have zero gain and zero count together
+        assert np.array_equal(split == 0, gain == 0)
+
+
+class TestInitScore:
+    def test_training_continues_from_init_score(self):
+        """A strong init_score should change the learned residual model
+        (ref: Dataset.set_init_score / boost_from_average interplay)."""
+        X, y = make_regression(600)
+        base = np.full(len(y), y.mean(), np.float64)
+        ds = lgb.Dataset(X, label=y)
+        ds.set_init_score(base)
+        bst = lgb.train({"objective": "regression", "num_leaves": 15,
+                         "verbosity": -1, "boost_from_average": False},
+                        ds, num_boost_round=20)
+        # predictions EXCLUDE the dataset init_score (reference
+        # semantics): adding it back should fit y well
+        pred = bst.predict(X) + base
+        assert np.mean((pred - y) ** 2) < np.var(y) * 0.2
+
+
+class TestMulticlassPredictShape:
+    def test_proba_rows_sum_to_one(self):
+        X, y = make_multiclass(600)
+        bst = lgb.train({"objective": "multiclass", "num_class": 4,
+                         "num_leaves": 7, "verbosity": -1},
+                        lgb.Dataset(X, label=y), num_boost_round=5)
+        proba = bst.predict(X)
+        assert proba.shape == (600, 4)
+        np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
+        raw = bst.predict(X, raw_score=True)
+        assert raw.shape == (600, 4)
