@@ -834,21 +834,24 @@ def grow_tree_waved(bins_fm: jax.Array,
 
         # --- wave boundary: ONE multi-leaf pass builds all the wave's
         # smaller children; siblings come from subtraction
-        # (ref: serial_tree_learner.cpp:582 histogram subtraction)
+        # (ref: serial_tree_learner.cpp:582 histogram subtraction).
+        # One batched gather + two batched scatters instead of a W-long
+        # unrolled chain: a wave's split leaves are pairwise distinct
+        # (a split leaf's candidate becomes `unknown` within the wave),
+        # and invalid steps write to the out-of-bounds row L, which jit
+        # scatters drop — so the batch has no index collisions.
         small_ids = jnp.where(ys["valid"], ys["small_id"], -2)
         smalls = multi(bins_fm, ghT, row_leaf, small_ids)  # [W, F, B, 3]
-        for i in range(W):
-            valid = ys["valid"][i]
-            left_id, right_id = ys["left_id"][i], ys["right_id"][i]
-            parent_hist = pool[left_id]
-            small_h = smalls[i].astype(f32)
-            large_h = hist_ops.subtract_histogram(parent_hist, small_h)
-            left_h = jnp.where(ys["left_smaller"][i], small_h, large_h)
-            right_h = jnp.where(ys["left_smaller"][i], large_h, small_h)
-            pool = pool.at[left_id].set(
-                jnp.where(valid, left_h, parent_hist))
-            pool = pool.at[right_id].set(
-                jnp.where(valid, right_h, pool[right_id]))
+        parents = pool[ys["left_id"]]                      # [W, F, B, 3]
+        small_h = smalls.astype(f32)
+        large_h = hist_ops.subtract_histogram(parents, small_h)
+        ls = ys["left_smaller"][:, None, None, None]
+        left_h = jnp.where(ls, small_h, large_h)
+        right_h = jnp.where(ls, large_h, small_h)
+        left_w = jnp.where(ys["valid"], ys["left_id"], L)
+        right_w = jnp.where(ys["valid"], ys["right_id"], L)
+        pool = pool.at[left_w].set(left_h)
+        pool = pool.at[right_w].set(right_h)
 
         # --- candidates for the 2W children, batched
         child_ids = jnp.concatenate([ys["left_id"], ys["right_id"]])
