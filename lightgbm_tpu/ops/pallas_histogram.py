@@ -274,16 +274,52 @@ def hist_pallas_multi_int8(bins_fm: jax.Array, ghT_i8: jax.Array,
 
 def hist_multi_xla(bins_fm, ghT, row_leaf, leaf_ids, *, max_bins: int,
                    num_slots: int) -> jax.Array:
-    """XLA fallback (CPU tests): loop leaves over build_histogram."""
-    from .histogram import build_histogram
-    outs = []
-    for j in range(num_slots):
-        # ghT channels are pre-masked (grad*w, hess*w, w) with w in {0,1},
-        # so the extra *mask inside build_histogram is idempotent
-        m = (row_leaf == leaf_ids[j]).astype(jnp.float32) * ghT[:, 2]
-        outs.append(build_histogram(bins_fm, ghT[:, 0], ghT[:, 1], m,
-                                    max_bins=max_bins, impl="xla"))
-    return jnp.stack(outs)
+    """XLA fallback (CPU tests + CPU bench): ALL leaf slots in one
+    contraction per feature. The bin one-hot is built once and dotted
+    against the per-slot masked channels packed side-by-side — the
+    former per-slot loop rebuilt the one-hot `num_slots` times, roughly
+    doubling the work and unrolling W separate passes into the HLO."""
+    from jax import lax
+
+    from .histogram import _hist_all_features
+
+    s = num_slots
+    n = ghT.shape[0]
+    f = bins_fm.shape[0]
+
+    def hist_of(bins_part, gh_part, leaf_part):
+        # [S, c] row->slot selection; ghT channels are pre-masked
+        # (g*w, h*w, w) with w in {0,1}, so multiplying by the selector
+        # alone reproduces the old per-slot mask exactly
+        sel = (leaf_part[None, :] == leaf_ids[:, None]).astype(jnp.float32)
+        ghs = (sel[:, :, None] * gh_part[None, :, :])          # [S, c, 3]
+        ghs = jnp.moveaxis(ghs, 0, 1).reshape(-1, s * 3)       # [c, S*3]
+        # _hist_all_features is generic over the trailing dim
+        return _hist_all_features(bins_part, ghs, max_bins, jnp.float32)
+
+    chunk = 131072  # bounds the [c, S*3] packed operand to ~64MB at S=42
+    if n > chunk:
+        pad = (-n) % chunk
+        # padded rows contribute nothing: their gh channels are zero and
+        # their leaf sentinel -7 matches no slot (invalid slots are -2)
+        ghp = jnp.pad(ghT, ((0, pad), (0, 0)))
+        binsp = jnp.pad(bins_fm, ((0, 0), (0, pad)))
+        leafp = jnp.pad(row_leaf, (0, pad), constant_values=-7)
+        nchunk = (n + pad) // chunk
+        ghc = ghp.reshape(nchunk, chunk, 3)
+        binsc = jnp.swapaxes(binsp.reshape(f, nchunk, chunk), 0, 1)
+        leafc = leafp.reshape(nchunk, chunk)
+
+        def one_chunk(acc, inputs):
+            b, g, lf = inputs
+            return acc + hist_of(b, g, lf), None
+
+        init = jnp.zeros((f, max_bins, s * 3), jnp.float32)
+        hist, _ = lax.scan(one_chunk, init, (binsc, ghc, leafc))
+    else:
+        hist = hist_of(bins_fm, ghT, row_leaf)
+    hist = hist.reshape(f, max_bins, s, 3)
+    return jnp.moveaxis(hist, 2, 0)  # [S, F, B, 3]
 
 
 def hist_multi(bins_fm, ghT, row_leaf, leaf_ids, *, max_bins: int,
