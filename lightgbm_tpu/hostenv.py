@@ -31,4 +31,18 @@ def cpu_child_env(n_devices: Optional[int] = None,
                  if "host_platform_device_count" not in f]
         flags.append(f"--xla_force_host_platform_device_count={n_devices}")
         env["XLA_FLAGS"] = " ".join(flags)
+    # Persistent compilation cache: the driver invokes helper processes
+    # (multichip dryrun, bench) cold on a contended 1-core host; without a
+    # warm cache every invocation recompiles from scratch and can blow the
+    # driver's timeout (rounds 3+4: rc=124). Cache everything, however
+    # small/fast, so a warmed program is a disk hit for the driver.
+    env.setdefault("JAX_COMPILATION_CACHE_DIR", _repo_cache_dir())
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
     return env
+
+
+def _repo_cache_dir() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache")
