@@ -193,7 +193,7 @@ class GBDT:
         self._grad_scale = None  # GOSS amplification, set per iter
 
         # grown-tree jit (shared across iterations; one XLA program per tree)
-        self._build_grow(hist_ops.default_impl())
+        self._build_grow(hist_ops.resolve_impl(config.tpu_hist_impl))
         self._update_score = jax.jit(
             lambda score, leaf_vals, row_leaf: score + leaf_vals[row_leaf])
         self._valid_sets: List = []
@@ -267,8 +267,9 @@ class GBDT:
                     out[gi, used_map[raw_f]] = True
         return out
 
-    def _build_grow(self, hist_impl: str) -> None:
+    def _build_grow(self, hist_impl: str, shard_mesh=None) -> None:
         self._hist_impl = hist_impl
+        self._shard_mesh = shard_mesh
         self._has_categorical = any(
             m.is_categorical for m in self.train_set.mappers)
         # per-node randomness (extra-trees thresholds, by-node feature
@@ -276,14 +277,7 @@ class GBDT:
         self._use_node_rand = (self.config.extra_trees or
                                self.config.feature_fraction_bynode < 1.0)
         self._extra_key = jax.random.PRNGKey(self.config.extra_seed)
-        self._grow = jax.jit(functools.partial(
-            self._grow_fn(), **self._grow_kwargs(),
-            hist_dtype=jnp.float32, hist_impl=hist_impl,
-            hist_precision=self.config.tpu_hist_precision,
-            interaction_groups=self._interaction_groups,
-            has_categorical=self._has_categorical,
-            extra_trees=bool(self.config.extra_trees),
-            ff_bynode=float(self.config.feature_fraction_bynode)))
+        self._grow = jax.jit(self._grow_partial())
         self._fused = None
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
@@ -460,7 +454,8 @@ class GBDT:
                                  has_categorical=self._has_categorical,
                                  extra_trees=bool(self.config.extra_trees),
                                  ff_bynode=float(
-                                     self.config.feature_fraction_bynode))
+                                     self.config.feature_fraction_bynode),
+                                 shard_mesh=self._shard_mesh)
 
     def _grow_class_traced(self, grow, bins_fm, k, key, grad, hess,
                            sample_mask, scores_k, it):

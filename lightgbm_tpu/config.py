@@ -171,6 +171,7 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_donate_buffers": [],
     "tpu_wave_max": [],
     "tpu_hist_precision": [],
+    "tpu_hist_impl": [],
     "tpu_dart_fused_max_bytes": [],
 }
 
@@ -454,6 +455,11 @@ class Config:
     # Measured on the TPU chip: "default" matches "highest" AUC to
     # ~1e-3 at Higgs shape while cutting iteration time ~2x.
     tpu_hist_precision: str = "default"
+    # histogram kernel implementation: "auto" = pallas on TPU backends /
+    # one-hot XLA contraction elsewhere; "pallas" / "xla" force one
+    # (pallas on CPU runs in interpret mode — tests use this to exercise
+    # the kernel + its shard_map mesh wrapper without a chip)
+    tpu_hist_impl: str = "auto"
     # DART fused-path budget: the per-tree leaf-assignment history
     # ([T, K, N] device buffer that lets dropped-tree contributions be
     # recomputed without host round-trips) is only kept below this many

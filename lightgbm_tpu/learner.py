@@ -168,6 +168,89 @@ def _node_randomness(node_key, salt, meta, feature_mask,
     return rb, fm
 
 
+def _pad_rows(arrays, axes, n: int, mult: int, pad_values):
+    """Pad each array's row axis (given per-array in `axes`) so the row
+    count divides `mult` — shard_map needs equal per-device slices."""
+    pad = (-n) % mult
+    if pad == 0:
+        return arrays
+    out = []
+    for a, ax, v in zip(arrays, axes, pad_values):
+        cfg = [(0, 0)] * a.ndim
+        cfg[ax] = (0, pad)
+        out.append(jnp.pad(a, cfg, constant_values=v))
+    return out
+
+
+def _sharded_pallas_build(shard_mesh, *, max_bins: int, dtype,
+                          row_chunk: int, precision: str):
+    """Single-leaf histogram build distributed over the mesh row axis:
+    each shard runs the pallas kernel on its rows, results psum-reduce —
+    the shard_map analog of HistogramSumReducer + Allreduce
+    (ref: data_parallel_tree_learner.cpp:287-297)."""
+    from jax.sharding import PartitionSpec as P
+    axis = shard_mesh.axis_names[0]
+
+    def local(b_l, g_l, h_l, m_l):
+        hl = hist_ops.build_histogram(
+            b_l, g_l, h_l, m_l, max_bins=max_bins, dtype=dtype,
+            row_chunk=row_chunk, impl="pallas", precision=precision)
+        return lax.psum(hl, axis)
+
+    fn = jax.shard_map(local, mesh=shard_mesh,
+                       in_specs=(P(None, axis), P(axis), P(axis), P(axis)),
+                       out_specs=P(), check_vma=False)
+
+    def build(bins, g, h, m):
+        # padded rows carry mask 0 -> no histogram contribution
+        bins, g, h, m = _pad_rows((bins, g, h, m), (1, 0, 0, 0),
+                                  bins.shape[1], shard_mesh.size,
+                                  (0, 0.0, 0.0, 0.0))
+        return fn(bins, g, h, m)
+    return build
+
+
+def _sharded_pallas_multi(shard_mesh, *, max_bins: int,
+                          precision: str, int8: bool):
+    """Multi-leaf wave histogram pass distributed over the mesh row axis.
+
+    int8=True: the int8 x int8 -> int32 MXU kernel runs per shard and the
+    psum reduces INT32 histograms — exact integer accumulation across the
+    mesh, the collective analog of the reference's quantized histogram
+    reduction (ref: data_parallel_tree_learner.cpp:290-297, which reduces
+    packed integer bins instead of floats). Callers dequantize AFTER the
+    reduce, so cross-shard sums are exact multiples of the grad/hess
+    scales.
+    """
+    from jax.sharding import PartitionSpec as P
+    from .ops.pallas_histogram import hist_pallas_multi, \
+        hist_pallas_multi_int8
+    axis = shard_mesh.axis_names[0]
+
+    def local(b_l, ghT_l, rl_l, ids):
+        if int8:
+            h = hist_pallas_multi_int8(b_l, ghT_l, rl_l, ids,
+                                       max_bins=max_bins,
+                                       num_slots=ids.shape[0])
+        else:
+            h = hist_pallas_multi(b_l, ghT_l, rl_l, ids, max_bins=max_bins,
+                                  num_slots=ids.shape[0], precise=precision)
+        return lax.psum(h, axis)
+
+    fn = jax.shard_map(local, mesh=shard_mesh,
+                       in_specs=(P(None, axis), P(axis, None), P(axis), P()),
+                       out_specs=P(), check_vma=False)
+
+    def multi(bins, ghT, row_leaf, ids):
+        # padded rows: leaf id -1 matches no slot (slots are >= 0 or the
+        # invalid sentinel -2), gh rows are zero
+        bins, ghT, row_leaf = _pad_rows((bins, ghT, row_leaf), (1, 0, 0),
+                                        bins.shape[1], shard_mesh.size,
+                                        (0, 0, -1))
+        return fn(bins, ghT, row_leaf, ids)
+    return multi
+
+
 def grow_tree(bins_fm: jax.Array,
               grad: jax.Array,
               hess: jax.Array,
@@ -191,8 +274,15 @@ def grow_tree(bins_fm: jax.Array,
               ff_bynode: float = 1.0,
               bundle=None,
               num_bundle_bins: int = 0,
-              mono_pairwise: bool = False):
+              mono_pairwise: bool = False,
+              shard_mesh=None):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
+
+    shard_mesh: a 1-D jax.sharding.Mesh with rows sharded over its axis.
+    With hist_impl="pallas", histogram builds run per-shard inside
+    shard_map (pallas_call does not auto-partition under GSPMD) and are
+    psum-reduced — the device analog of HistogramSumReducer
+    (ref: data_parallel_tree_learner.cpp:287-297).
 
     mono_pairwise: use the exact pairwise leaf-box monotone bounds
     (monotone_constraints_method intermediate/advanced — see
@@ -215,10 +305,18 @@ def grow_tree(bins_fm: jax.Array,
     L = num_leaves
     f32 = hist_dtype
 
-    if bundle is None:
-        build = functools.partial(
-            hist_ops.build_histogram, max_bins=max_bins, dtype=f32,
+    build_bins = max_bins if bundle is None else num_bundle_bins
+    if shard_mesh is not None and shard_mesh.size > 1 and \
+            hist_impl == "pallas":
+        raw_build = _sharded_pallas_build(
+            shard_mesh, max_bins=build_bins, dtype=f32,
+            row_chunk=row_chunk, precision=hist_precision)
+    else:
+        raw_build = functools.partial(
+            hist_ops.build_histogram, max_bins=build_bins, dtype=f32,
             row_chunk=row_chunk, impl=hist_impl, precision=hist_precision)
+    if bundle is None:
+        build = raw_build
     else:
         # EFB: build on the bundled [G, N] columns, expand to the logical
         # per-feature layout (ref: dataset.cpp:251 FastFeatureBundling)
@@ -226,10 +324,7 @@ def grow_tree(bins_fm: jax.Array,
         group_of, offset_of, nb_arr = bundle
 
         def build(bins, grad_, hess_, mask_):
-            hg = hist_ops.build_histogram(
-                bins, grad_, hess_, mask_, max_bins=num_bundle_bins,
-                dtype=f32, row_chunk=row_chunk, impl=hist_impl,
-                precision=hist_precision)  # [G, B_tot, 3]
+            hg = raw_build(bins, grad_, hess_, mask_)  # [G, B_tot, 3]
             totals = jnp.sum(hg[0], axis=0)  # every row hits group 0 once
             return expand_bundle_hist(hg, group_of, offset_of, nb_arr,
                                       max_bins, totals)
@@ -562,7 +657,8 @@ def grow_tree_waved(bins_fm: jax.Array,
                     quant: Optional[tuple] = None,
                     bundle=None,
                     num_bundle_bins: int = 0,
-                    mono_pairwise: bool = False):
+                    mono_pairwise: bool = False,
+                    shard_mesh=None):
     """Leaf-wise growth with waved (batched) histogram construction.
 
     Identical split mathematics to `grow_tree`, but histogram builds are
@@ -602,6 +698,8 @@ def grow_tree_waved(bins_fm: jax.Array,
     SLOTS = 42  # 128 MXU columns // 3 channels
     build_bins = max_bins if bundle is None else num_bundle_bins
 
+    use_shard_hist = (shard_mesh is not None and shard_mesh.size > 1
+                      and hist_impl == "pallas")
     if quant is not None and hist_impl == "pallas":
         g_int, h_int, g_scale, h_scale = quant
         m8 = sample_mask.astype(jnp.int8)
@@ -609,12 +707,28 @@ def grow_tree_waved(bins_fm: jax.Array,
                             h_int.astype(jnp.int8) * m8, m8], axis=1)
         hscale_vec = jnp.stack([g_scale, h_scale,
                                 jnp.float32(1.0)]).astype(f32)
+        if use_shard_hist:
+            # per-shard int8 kernel + INT32 psum: the cross-mesh reduce
+            # moves exact integer histograms and dequantizes after —
+            # the collective analog of the reference's quantized
+            # histogram reduction (data_parallel_tree_learner.cpp:290)
+            _multi_i32 = _sharded_pallas_multi(
+                shard_mesh, max_bins=build_bins,
+                precision=hist_precision, int8=True)
 
-        def multi_raw(bins, ghT_unused, row_leaf, ids):
-            hist_i = hist_pallas_multi_int8(bins, ghT_i8, row_leaf, ids,
-                                            max_bins=build_bins,
-                                            num_slots=ids.shape[0])
-            return hist_i.astype(f32) * hscale_vec
+            def multi_raw(bins, ghT_unused, row_leaf, ids):
+                return _multi_i32(bins, ghT_i8, row_leaf,
+                                  ids).astype(f32) * hscale_vec
+        else:
+            def multi_raw(bins, ghT_unused, row_leaf, ids):
+                hist_i = hist_pallas_multi_int8(bins, ghT_i8, row_leaf, ids,
+                                                max_bins=build_bins,
+                                                num_slots=ids.shape[0])
+                return hist_i.astype(f32) * hscale_vec
+    elif use_shard_hist:
+        multi_raw = _sharded_pallas_multi(
+            shard_mesh, max_bins=build_bins, precision=hist_precision,
+            int8=False)
     else:
         def multi_raw(bins, ghT_, row_leaf, ids):
             # num_slots = the wave's LIVE count: the pallas kernel's cost

@@ -47,6 +47,11 @@ def default_impl() -> str:
         return "xla"
 
 
+def resolve_impl(cfg_impl: str) -> str:
+    """Config tpu_hist_impl -> concrete impl ('auto' = default_impl())."""
+    return default_impl() if cfg_impl in (None, "", "auto") else cfg_impl
+
+
 @functools.partial(jax.jit, static_argnames=("max_bins", "dtype", "row_chunk",
                                              "impl", "precision"))
 def build_histogram(bins_fm: jax.Array, grad: jax.Array, hess: jax.Array,

@@ -39,6 +39,17 @@ def resolve_precision(precise) -> lax.Precision:
     return _PRECISIONS[precise]
 
 
+def _resolve_interpret(interpret) -> bool:
+    """None = auto: interpret mode on CPU (tests exercise the kernels and
+    their shard_map mesh wrappers without a chip), Mosaic on TPU."""
+    if interpret is not None:
+        return interpret
+    try:
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return True
+
+
 def _hist_kernel(bins_ref, gh_ref, out_ref, *, f_blk: int, max_bins: int,
                  precise: bool):
     i = pl.program_id(1)
@@ -101,7 +112,7 @@ def _multi_kernel(bins_ref, ghT_ref, rlT_ref, leafsel_ref, out_ref, *,
 def hist_pallas_multi(bins_fm: jax.Array, ghT: jax.Array, row_leaf: jax.Array,
                       leaf_ids: jax.Array, *, max_bins: int, num_slots: int,
                       row_chunk: int = 2048, precise="highest",
-                      interpret: bool = False) -> jax.Array:
+                      interpret=None) -> jax.Array:
     """Histograms of up to `num_slots` leaves in ONE pass over the rows.
 
     The one-hot (bins) operand is leaf-independent, so packing the MXU's
@@ -161,7 +172,7 @@ def hist_pallas_multi(bins_fm: jax.Array, ghT: jax.Array, row_leaf: jax.Array,
         out_specs=pl.BlockSpec((1, rows, 128), lambda j, i: (j, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((fblocks, rows, 128), jnp.float32),
-        interpret=interpret,
+        interpret=_resolve_interpret(interpret),
     )(bins_fm, ghT, row_leaf[:, None].astype(jnp.int32), leafsel)
     # [fblocks, f_blk*B, 128] -> [F, B, J, 3] -> [J, F, B, 3]
     out = out[:, :, :3 * num_slots]
@@ -215,7 +226,7 @@ def hist_pallas_multi_int8(bins_fm: jax.Array, ghT_i8: jax.Array,
                            row_leaf: jax.Array, leaf_ids: jax.Array, *,
                            max_bins: int, num_slots: int,
                            row_chunk: int = 2048,
-                           interpret: bool = False) -> jax.Array:
+                           interpret=None) -> jax.Array:
     """Quantized multi-leaf histograms: one pass, int32 accumulation.
 
     ghT_i8: [N, 3] int8 (quantized grad, quantized hess, {0,1} weight),
@@ -264,7 +275,7 @@ def hist_pallas_multi_int8(bins_fm: jax.Array, ghT_i8: jax.Array,
         out_specs=pl.BlockSpec((1, rows, 128), lambda j, i: (j, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((fblocks, rows, 128), jnp.int32),
-        interpret=interpret,
+        interpret=_resolve_interpret(interpret),
     )(bins_fm, ghT_i8, row_leaf[:, None].astype(jnp.int32), leafsel)
     out = out[:, :, :3 * num_slots]
     out = out.reshape(fp, max_bins, num_slots, 3)
@@ -339,7 +350,7 @@ def hist_multi(bins_fm, ghT, row_leaf, leaf_ids, *, max_bins: int,
                                     "precise", "interpret"))
 def hist_pallas(bins_fm: jax.Array, gh3: jax.Array, *, max_bins: int,
                 f_blk: int = 8, row_chunk: int = 0,
-                precise="highest", interpret: bool = False) -> jax.Array:
+                precise="highest", interpret=None) -> jax.Array:
     """bins_fm [F, N] uint8/uint16, gh3 [3, N] f32 (pre-masked) ->
     hist [F, B, 3] f32."""
     num_features, n = bins_fm.shape
@@ -375,7 +386,7 @@ def hist_pallas(bins_fm: jax.Array, gh3: jax.Array, *, max_bins: int,
         out_specs=pl.BlockSpec((f_blk, 3, max_bins), lambda j, i: (j, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((fp, 3, max_bins), jnp.float32),
-        interpret=interpret,
+        interpret=_resolve_interpret(interpret),
     )(bins_fm, gh3)
     # [F, 3, B] -> [F, B, 3] to match the XLA path's layout
     return jnp.swapaxes(out[:num_features], 1, 2)

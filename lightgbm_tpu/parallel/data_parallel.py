@@ -34,20 +34,6 @@ from ..objectives import ObjectiveFunction
 from . import mesh as mesh_lib
 
 
-def _static_for_parallel(static: dict, learner: str) -> dict:
-    """The serial grower's static kwargs minus the ones the sharded
-    growers don't implement (pairwise monotone bounds fall back to
-    basic there, like the reference's parallel learners)."""
-    static = dict(static)
-    if static.pop("mono_pairwise", False):
-        import warnings
-        warnings.warn(
-            f"monotone_constraints_method intermediate/advanced is "
-            f"not supported by tree_learner={learner}; using the "
-            "basic method")
-    return static
-
-
 class _DataParallelMixin:
     """Shards row-indexed device state over the mesh data axis."""
 
@@ -55,6 +41,24 @@ class _DataParallelMixin:
         self.mesh = mesh_lib.get_mesh(num_shards)
         if jax.process_count() > 1:
             self._setup_multihost()
+            return
+        if self.num_data % max(self.mesh.size, 1) != 0:
+            # NamedSharding needs equal shards. Row tensors stay
+            # replicated; the pallas histogram path still distributes its
+            # passes (the shard_map wrapper pads rows to a mesh multiple
+            # internally, learner._pad_rows), the XLA path degrades to a
+            # replicated program.
+            import warnings
+            warnings.warn(
+                f"num_data={self.num_data} is not divisible by the "
+                f"{self.mesh.size}-device mesh; row tensors are kept "
+                "replicated (pad the dataset to a mesh multiple for "
+                "fully sharded storage)")
+            self.feature_meta = jax.tree_util.tree_map(
+                lambda a: mesh_lib.replicate(self.mesh, a),
+                self.feature_meta)
+            if self.mesh.size > 1:
+                self._build_grow_sharded()
             return
         # bins [F, N]: rows sharded, features replicated
         self.bins_fm = mesh_lib.shard_data(self.mesh, self.bins_fm, row_axis=1)
@@ -65,9 +69,18 @@ class _DataParallelMixin:
         self.feature_meta = jax.tree_util.tree_map(
             lambda a: mesh_lib.replicate(self.mesh, a), self.feature_meta)
         if self.mesh.size > 1:
-            # pallas_call does not auto-partition under GSPMD; the XLA
-            # one-hot path partitions its contraction over the sharded row
-            # axis (shard_map + pallas planned)
+            self._build_grow_sharded()
+
+    def _build_grow_sharded(self):
+        """pallas_call does not auto-partition under GSPMD, so the pallas
+        histogram kernels run per-shard inside shard_map with an explicit
+        psum (learner._sharded_pallas_{build,multi}); the XLA one-hot
+        path instead partitions its contraction automatically."""
+        from ..ops import histogram as hist_ops
+        impl = hist_ops.resolve_impl(self.config.tpu_hist_impl)
+        if impl == "pallas":
+            self._build_grow("pallas", shard_mesh=self.mesh)
+        else:
             self._build_grow("xla")
 
     def _setup_multihost(self):
@@ -118,7 +131,7 @@ class _DataParallelMixin:
                     garr = jax.device_put(np.asarray(arr),
                                           NamedSharding(mesh, P()))
                 setattr(self.objective, name, garr)
-        self._build_grow("xla")
+        self._build_grow_sharded()
 
     def _sync_init_scores(self, scores: np.ndarray) -> np.ndarray:
         # per-machine init scores averaged across processes
@@ -158,18 +171,31 @@ class VotingParallelGBDT(_DataParallelMixin, GBDT):
             import warnings
             warnings.warn("forced splits / interaction constraints are "
                           "not supported by tree_learner=voting; ignoring")
+        if self.mesh.size > 1 and self.num_data % self.mesh.size != 0:
+            # the voting grower's shard_map shards rows over the mesh,
+            # which needs equal slices; the data-parallel grower the
+            # mixin already installed handles this case (its pallas
+            # wrapper pads internally, its XLA path runs replicated)
+            import warnings
+            warnings.warn(
+                f"tree_learner=voting needs num_data divisible by the "
+                f"{self.mesh.size}-device mesh (have {self.num_data}); "
+                "using the data-parallel grower instead")
+            return
         if self.mesh.size > 1:
             if config.extra_trees or config.feature_fraction_bynode < 1.0:
                 import warnings
                 warnings.warn(
                     "extra_trees / feature_fraction_bynode are not "
                     "supported by the sharded voting learner; ignoring")
+            from ..ops import histogram as hist_ops
             from .voting import make_sharded_voting_grow
             top_k = max(1, min(int(config.top_k),
                                self.train_set.num_features))
-            static = _static_for_parallel(self._static, "voting")
+            static = dict(self._static)
             grow = make_sharded_voting_grow(
-                self.mesh, top_k=top_k, hist_impl="xla",
+                self.mesh, top_k=top_k,
+                hist_impl=hist_ops.resolve_impl(config.tpu_hist_impl),
                 has_categorical=self._has_categorical, **static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
@@ -210,10 +236,12 @@ class FeatureParallelGBDT(GBDT):
             self.feature_meta = jax.tree_util.tree_map(
                 lambda a: mesh_lib.replicate(self.mesh, a),
                 self.feature_meta)
+            from ..ops import histogram as hist_ops
             from .feature_parallel import make_sharded_feature_grow
-            static = _static_for_parallel(self._static, "feature")
+            static = dict(self._static)
             grow = make_sharded_feature_grow(
-                self.mesh, hist_impl="xla",
+                self.mesh,
+                hist_impl=hist_ops.resolve_impl(config.tpu_hist_impl),
                 has_categorical=self._has_categorical, **static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,

@@ -26,6 +26,7 @@ from jax.sharding import PartitionSpec as P
 from ..learner import TreeArrays, _LeafSplits, _store_split
 from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
+from ..ops import split as split_ops
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams,
                          SplitInfo, find_best_split, leaf_output,
                          propagate_monotone_bounds)
@@ -50,9 +51,15 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
                                axis_name: str = mesh_lib.DATA_AXIS,
                                hist_dtype=jnp.float32,
                                hist_impl: str = "xla",
-                               has_categorical: bool = True):
+                               has_categorical: bool = True,
+                               mono_pairwise: bool = False):
     """Runs INSIDE shard_map with fully-replicated inputs; each shard
-    works on its feature slice. Outputs are replicated."""
+    works on its feature slice. Outputs are replicated.
+
+    mono_pairwise: exact pairwise leaf-box bounds (intermediate/advanced
+    monotone methods); the [L, F] box state is over GLOBAL feature
+    indices and fully replicated — identical deterministic updates on
+    every shard, no extra collective."""
     num_features = bins_fm.shape[0]
     L = num_leaves
     f32 = hist_dtype
@@ -104,9 +111,13 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
     pool = jnp.zeros((L, fp, max_bins, hist_ops.NUM_HIST_CHANNELS), f32)
     pool = pool.at[0].set(root_hist)
     row_leaf0 = jnp.zeros((bins_fm.shape[1],), jnp.int32)
+    box_lo0 = (jnp.zeros((L, num_features), jnp.int32)
+               if mono_pairwise else None)
+    box_hi0 = (jnp.full((L, num_features), max_bins - 1, jnp.int32)
+               if mono_pairwise else None)
 
     def step(carry, step_idx):
-        row_leaf, pool, leaves = carry
+        row_leaf, pool, leaves, box_lo, box_hi = carry
         best_leaf = jnp.argmax(leaves.gain).astype(jnp.int32)
         valid = leaves.gain[best_leaf] > 0.0
         new_leaf = (step_idx + 1).astype(jnp.int32)
@@ -148,9 +159,32 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
         out_l = leaves.left_output[best_leaf]
         out_r = leaves.right_output[best_leaf]
 
-        l_min, l_max, r_min, r_max = propagate_monotone_bounds(
-            out_l, out_r, meta.monotone[feat].astype(jnp.int32),
-            meta.is_categorical[feat], p_minb, p_maxb)
+        if mono_pairwise:
+            # see voting.py: re-clip stored candidate outputs to the
+            # CURRENT bounds, then refresh pairwise leaf-box bounds
+            out_l = jnp.clip(out_l, p_minb, p_maxb)
+            out_r = jnp.clip(out_r, p_minb, p_maxb)
+            box_lo, box_hi = split_ops.split_child_boxes(
+                box_lo, box_hi, best_leaf, new_leaf, feat, thr,
+                meta.is_categorical[feat], valid)
+            out_now = leaves.output.at[best_leaf].set(
+                jnp.where(valid, out_l, parent_out))
+            out_now = out_now.at[new_leaf].set(
+                jnp.where(valid, out_r,
+                          out_now[jnp.minimum(new_leaf, L - 1)]))
+            leaf_in_use = jnp.arange(L, dtype=jnp.int32) <= \
+                jnp.where(valid, new_leaf, step_idx)
+            minb_all, maxb_all = split_ops.compute_box_bounds(
+                box_lo, box_hi, out_now, leaf_in_use, meta.monotone)
+            leaves = leaves._replace(
+                min_bound=jnp.where(valid, minb_all, leaves.min_bound),
+                max_bound=jnp.where(valid, maxb_all, leaves.max_bound))
+            l_min, l_max = minb_all[best_leaf], maxb_all[best_leaf]
+            r_min, r_max = minb_all[new_leaf], maxb_all[new_leaf]
+        else:
+            l_min, l_max, r_min, r_max = propagate_monotone_bounds(
+                out_l, out_r, meta.monotone[feat].astype(jnp.int32),
+                meta.is_categorical[feat], p_minb, p_maxb)
 
         child_depth = leaves.depth[best_leaf] + 1
         pen_depth = child_depth - 1
@@ -183,10 +217,10 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
             internal_weight=ph,
             internal_count=pc,
         )
-        return (row_leaf, pool, leaves), record
+        return (row_leaf, pool, leaves, box_lo, box_hi), record
 
-    (row_leaf, pool, leaves), records = lax.scan(
-        step, (row_leaf0, pool, leaves),
+    (row_leaf, pool, leaves, _, _), records = lax.scan(
+        step, (row_leaf0, pool, leaves, box_lo0, box_hi0),
         jnp.arange(L - 1, dtype=jnp.int32), unroll=2 if L > 2 else 1)
 
     num_leaves_out = 1 + jnp.sum(records["split_leaf"] >= 0).astype(
@@ -211,13 +245,15 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
 
 def make_sharded_feature_grow(mesh, *, num_leaves: int, max_bins: int,
                               hist_impl: str = "xla",
-                              has_categorical: bool = True):
+                              has_categorical: bool = True,
+                              mono_pairwise: bool = False):
     """jit(shard_map(grow_tree_feature_parallel)): everything replicated
     in and out; sharding is purely over the computation."""
     grow = functools.partial(grow_tree_feature_parallel,
                              num_leaves=num_leaves, max_bins=max_bins,
                              num_shards=mesh.size, hist_impl=hist_impl,
-                             has_categorical=has_categorical)
+                             has_categorical=has_categorical,
+                             mono_pairwise=mono_pairwise)
     rep = P()
     meta_spec = FeatureMeta(*([rep] * len(FeatureMeta._fields)))
     hp_spec = SplitHyperParams(*([rep] * len(SplitHyperParams._fields)))

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 from ..learner import TreeArrays, _LeafSplits, _store_split
 from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
+from ..ops import split as split_ops
 from ..ops.histogram import COUNT, GRAD, HESS
 from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams,
                          find_best_split, leaf_output, per_feature_best_gain,
@@ -86,10 +87,18 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
                      *, num_leaves: int, max_bins: int, top_k: int,
                      axis_name: str = mesh_lib.DATA_AXIS,
                      hist_dtype=jnp.float32, hist_impl: str = "xla",
-                     has_categorical: bool = True):
+                     has_categorical: bool = True,
+                     mono_pairwise: bool = False):
     """Grow one tree with voting-parallel split search. Runs INSIDE
     shard_map: all row-indexed inputs are this shard's slice; returned
-    TreeArrays are replicated, row_leaf is the local slice."""
+    TreeArrays are replicated, row_leaf is the local slice.
+
+    mono_pairwise: exact pairwise leaf-box monotone bounds
+    (monotone_constraints_method intermediate/advanced). The [L, F] box
+    state is replicated across shards — every shard runs the identical
+    deterministic update, so no extra collective is needed (the
+    reference's constraint factory is likewise learner-agnostic,
+    monotone_constraints.hpp:330)."""
     num_data = bins_fm.shape[1]
     num_features = bins_fm.shape[0]
     L = num_leaves
@@ -136,9 +145,13 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
                       hist_ops.NUM_HIST_CHANNELS), f32)
     pool = pool.at[0].set(root_hist)
     row_leaf0 = jnp.zeros((num_data,), jnp.int32)
+    box_lo0 = (jnp.zeros((L, num_features), jnp.int32)
+               if mono_pairwise else None)
+    box_hi0 = (jnp.full((L, num_features), max_bins - 1, jnp.int32)
+               if mono_pairwise else None)
 
     def step(carry, step_idx):
-        row_leaf, pool, leaves = carry
+        row_leaf, pool, leaves, box_lo, box_hi = carry
         best_leaf = jnp.argmax(leaves.gain).astype(jnp.int32)
         valid = leaves.gain[best_leaf] > 0.0
         new_leaf = (step_idx + 1).astype(jnp.int32)
@@ -180,9 +193,36 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
         out_l = leaves.left_output[best_leaf]
         out_r = leaves.right_output[best_leaf]
 
-        l_min, l_max, r_min, r_max = propagate_monotone_bounds(
-            out_l, out_r, meta.monotone[feat].astype(jnp.int32),
-            meta.is_categorical[feat], p_minb, p_maxb)
+        if mono_pairwise:
+            # bounds may have tightened after OTHER leaves split since
+            # this candidate was stored (ref: RecomputeConstraintsIfNeeded
+            # monotone_constraints.hpp:52) — re-clip, then refresh all
+            # leaves' pairwise box bounds
+            out_l = jnp.clip(out_l, p_minb, p_maxb)
+            out_r = jnp.clip(out_r, p_minb, p_maxb)
+            box_lo, box_hi = split_ops.split_child_boxes(
+                box_lo, box_hi, best_leaf, new_leaf, feat, thr,
+                meta.is_categorical[feat], valid)
+            out_now = leaves.output.at[best_leaf].set(
+                jnp.where(valid, out_l, parent_out))
+            out_now = out_now.at[new_leaf].set(
+                jnp.where(valid, out_r,
+                          out_now[jnp.minimum(new_leaf, L - 1)]))
+            # validity is monotone here (no forced-split revival): after
+            # a valid step leaves 0..new_leaf are in use
+            leaf_in_use = jnp.arange(L, dtype=jnp.int32) <= \
+                jnp.where(valid, new_leaf, step_idx)
+            minb_all, maxb_all = split_ops.compute_box_bounds(
+                box_lo, box_hi, out_now, leaf_in_use, meta.monotone)
+            leaves = leaves._replace(
+                min_bound=jnp.where(valid, minb_all, leaves.min_bound),
+                max_bound=jnp.where(valid, maxb_all, leaves.max_bound))
+            l_min, l_max = minb_all[best_leaf], maxb_all[best_leaf]
+            r_min, r_max = minb_all[new_leaf], maxb_all[new_leaf]
+        else:
+            l_min, l_max, r_min, r_max = propagate_monotone_bounds(
+                out_l, out_r, meta.monotone[feat].astype(jnp.int32),
+                meta.is_categorical[feat], p_minb, p_maxb)
 
         child_depth = leaves.depth[best_leaf] + 1
         pen_depth = child_depth - 1
@@ -212,10 +252,10 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
             internal_weight=ph,
             internal_count=pc,
         )
-        return (row_leaf, pool, leaves), record
+        return (row_leaf, pool, leaves, box_lo, box_hi), record
 
-    (row_leaf, pool, leaves), records = lax.scan(
-        step, (row_leaf0, pool, leaves),
+    (row_leaf, pool, leaves, _, _), records = lax.scan(
+        step, (row_leaf0, pool, leaves, box_lo0, box_hi0),
         jnp.arange(L - 1, dtype=jnp.int32), unroll=2 if L > 2 else 1)
 
     num_leaves_out = 1 + jnp.sum(records["split_leaf"] >= 0).astype(
@@ -240,13 +280,15 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
 
 def make_sharded_voting_grow(mesh, *, num_leaves: int, max_bins: int,
                              top_k: int, hist_impl: str = "xla",
-                             has_categorical: bool = True):
+                             has_categorical: bool = True,
+                             mono_pairwise: bool = False):
     """jit(shard_map(grow_tree_voting)): rows sharded over "data",
     everything else replicated; tree replicated out, row_leaf sharded."""
     grow = functools.partial(grow_tree_voting, num_leaves=num_leaves,
                              max_bins=max_bins, top_k=top_k,
                              hist_impl=hist_impl,
-                             has_categorical=has_categorical)
+                             has_categorical=has_categorical,
+                             mono_pairwise=mono_pairwise)
     data = P(None, mesh_lib.DATA_AXIS)   # bins [F, N]
     rows = P(mesh_lib.DATA_AXIS)         # [N]
     rep = P()

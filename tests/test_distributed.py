@@ -157,3 +157,133 @@ def test_voting_program_contains_collectives():
         g.hp, jnp.int32(-1)).compile().as_text()
     assert "all-reduce" in hlo or "all-gather" in hlo, \
         "voting program lost its collectives"
+
+
+def test_mesh_pallas_hist_matches_serial():
+    """tpu_hist_impl=pallas under the 8-device mesh (interpret mode on
+    CPU): the shard_map per-shard kernel + psum wrapper must reproduce
+    single-device training (VERDICT r4 #5 — the flagship kernel on the
+    flagship multi-chip configuration). N=1003 exercises the row padding
+    to a mesh multiple."""
+    X, y = make_regression(1003)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1, "seed": 7,
+              "tpu_hist_precision": "highest"}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=8)
+    mesh_pallas = lgb.train({**params, "tree_learner": "data",
+                             "tpu_hist_impl": "pallas"},
+                            lgb.Dataset(X, label=y), num_boost_round=8)
+    np.testing.assert_allclose(mesh_pallas.predict(X), serial.predict(X),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_mesh_pallas_exact_grower_matches_serial():
+    """Same check for the exact (per-split) grower path."""
+    X, y = make_binary(1003)
+    params = {"objective": "binary", "num_leaves": 15, "tpu_wave_max": 0,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "tpu_hist_precision": "highest"}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=8)
+    mesh_pallas = lgb.train({**params, "tree_learner": "data",
+                             "tpu_hist_impl": "pallas"},
+                            lgb.Dataset(X, label=y), num_boost_round=8)
+    np.testing.assert_allclose(mesh_pallas.predict(X), serial.predict(X),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_mesh_quantized_int8_psum_matches_serial():
+    """use_quantized_grad on the mesh: the int8 kernel runs per shard and
+    the psum reduces INT32 histograms (exact integer accumulation across
+    shards — ref: data_parallel_tree_learner.cpp:290-297 reduces integer
+    bins). Same quantization RNG on both sides -> near-identical models."""
+    X, y = make_binary(1003)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "use_quantized_grad": True, "tpu_hist_impl": "pallas",
+              "tpu_hist_precision": "highest"}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=8)
+    meshq = lgb.train({**params, "tree_learner": "data"},
+                      lgb.Dataset(X, label=y), num_boost_round=8)
+    np.testing.assert_allclose(meshq.predict(X), serial.predict(X),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_mesh_quantized_reduce_is_integer_typed():
+    """Compiled-program proof that the quantized mesh reduction moves
+    int32 histograms, not dequantized f32 (VERDICT r4 #8): the program's
+    cross-shard all-reduce must carry s32 operands."""
+    import functools
+    import jax.numpy as jnp
+
+    X, y = make_binary(1024)
+    bst = lgb.Booster({"objective": "binary", "tree_learner": "data",
+                       "num_leaves": 7, "verbosity": -1,
+                       "use_quantized_grad": True,
+                       "tpu_hist_impl": "pallas"},
+                      lgb.Dataset(X, label=y))
+    g = bst._gbdt
+    n = g.num_data
+    grow = g._grow_partial()
+    quant = (jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32),
+             jnp.float32(0.5), jnp.float32(0.5))
+    lowered = jax.jit(functools.partial(grow, quant=quant)).lower(
+        g.bins_fm, jnp.zeros(n, jnp.float32), jnp.ones(n, jnp.float32),
+        jnp.ones(n, jnp.float32), jnp.ones(X.shape[1], bool),
+        g.feature_meta, g.hp, jnp.int32(-1), None, None)
+    # assert on the lowered program (CPU backend optimizations may later
+    # rewrite the collective): the all_reduce must consume the int8
+    # kernel's output and reduce i32 tensors, with the f32 dequantize
+    # AFTER it
+    shlo = lowered.as_text()
+    assert "all_reduce" in shlo, "quantized mesh grower lost its psum"
+    assert "hist_pallas_multi_int8" in shlo, \
+        "quantized mesh grower dropped the int8 pallas kernel"
+    import re
+    ar_types = []
+    for chunk in shlo.split('stablehlo.all_reduce')[1:]:
+        m = re.search(r'\^bb0\(%\w+: tensor<(\w+)>', chunk)
+        if m:
+            ar_types.append(m.group(1))
+    assert ar_types and all(t == "i32" for t in ar_types), \
+        f"expected i32 all_reduce reductions, got {ar_types}"
+
+
+def test_mono_pairwise_parallel_learners_match_serial():
+    """monotone_constraints_method=advanced under all three parallel
+    learners (VERDICT r4 #7): the pairwise leaf-box state is replicated
+    and deterministic, so each learner must reproduce its serial-strategy
+    result; previously these downgraded to the basic method with a
+    warning. Ref: monotone_constraints.hpp:330 (the reference's factory
+    is learner-agnostic too)."""
+    X, y = make_regression(1024)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1,
+              "monotone_constraints": "1,-1,0,0,0,0,0,0",
+              "monotone_constraints_method": "advanced",
+              # the sharded voting/feature learners grow exact leaf-wise;
+              # compare against the serial EXACT grower
+              "tpu_wave_max": 0}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=10)
+    ps = serial.predict(X)
+    # data-parallel: identical grower math under GSPMD
+    dp = lgb.train({**params, "tree_learner": "data"},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    np.testing.assert_allclose(dp.predict(X), ps, rtol=1e-3, atol=1e-3)
+    # feature-parallel: exact same split sequence
+    fp = lgb.train({**params, "tree_learner": "feature"},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    np.testing.assert_allclose(fp.predict(X), ps, rtol=1e-4, atol=1e-4)
+    # voting with top_k covering all features degenerates to data-parallel
+    vp = lgb.train({**params, "tree_learner": "voting", "top_k": 8},
+                   lgb.Dataset(X, label=y), num_boost_round=10)
+    np.testing.assert_allclose(vp.predict(X), ps, rtol=1e-3, atol=1e-3)
+    # and no downgrade warning fires
+    import warnings as _w
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        lgb.Booster({**params, "tree_learner": "voting", "top_k": 8,
+                     "verbosity": -1}, lgb.Dataset(X, label=y))
