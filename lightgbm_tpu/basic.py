@@ -371,9 +371,44 @@ class Booster:
         self._gbdt.add_valid(data._binned, data.data)
         return self
 
+    def reset_train_set(self, train_set: Dataset) -> "Booster":
+        """Replace the training data, keeping the current model
+        (ref: GBDT::ResetTrainingData gbdt.cpp:214 /
+        LGBM_BoosterResetTrainingData c_api.cpp:2086). The new data is
+        binned against the current mappers and the existing trees'
+        scores are replayed onto it."""
+        if self._gbdt is None:
+            raise LightGBMError(
+                "reset_train_set requires a booster built on a Dataset")
+        saved = None
+        if any(self._gbdt.models):
+            saved = load_model_from_string(self.model_to_string())
+        train_set.reference = train_set.reference or self.train_set
+        train_set.params = {**train_set.params, **self.params}
+        train_set.construct()
+        self.train_set = train_set
+        self._metrics_cache.clear()
+        objective = create_objective(self.config)
+        binned = train_set._binned
+        if self.config.tree_learner in ("data", "voting", "feature") or \
+                self.config.num_machines > 1 or \
+                int(self.params.get("tpu_num_shards", 0) or 0) > 1:
+            from .parallel.data_parallel import create_parallel_boosting
+            self._gbdt = create_parallel_boosting(self.config, binned,
+                                                  objective)
+        else:
+            self._gbdt = create_boosting(self.config, binned, objective)
+        if saved is not None:
+            self._gbdt.init_from_loaded(saved)
+        for ds in self._valid_sets:
+            self._gbdt.add_valid(ds._binned, ds.data)
+        return self
+
     def update(self, train_set: Optional[Dataset] = None, fobj=None) -> bool:
         """One boosting iteration; True means training should stop
         (ref: basic.py Booster.update -> LGBM_BoosterUpdateOneIter)."""
+        if train_set is not None and train_set is not self.train_set:
+            self.reset_train_set(train_set)
         self._ensure_network()
         if fobj is not None:
             grad, hess = fobj(self._raw_train_scores(), self.train_set)

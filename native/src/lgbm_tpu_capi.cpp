@@ -1,7 +1,7 @@
 // C-ABI compatibility shim: a subset of the reference's `LGBM_*` surface
-// (ref: include/LightGBM/c_api.h, 131 functions; this shim covers the 19
-// that dataset/booster lifecycle harnesses use, incl. dense + CSR
-// creation and prediction) backed by the lightgbm_tpu Python framework
+// (ref: include/LightGBM/c_api.h, 131 functions; this shim covers 78
+// covering dataset/booster lifecycle, streaming push (ChunkedArray flow),
+// fast single-row predict configs, and model surgery — backed by the lightgbm_tpu Python framework
 // through an embedded CPython interpreter.
 //
 // Design: every entry point forwards to lightgbm_tpu.capi with raw
@@ -425,4 +425,813 @@ LGBM_API int LGBM_BoosterFree(BoosterHandle handle) {
   Gil gil;
   return HandleResult(Call("handle_free", "(L)",
                            (long long)AsHandleInt(handle)));
+}
+
+// -- streaming dataset construction (ref: c_api.cpp:1330 PushRows family,
+// test scenarios: tests/cpp_tests/test_stream.cpp:253,304) ----------------
+
+LGBM_API int LGBM_DatasetCreateByReference(const DatasetHandle reference,
+                                           int64_t num_total_row,
+                                           DatasetHandle* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_create_by_reference", "(LL)",
+                     (long long)AsHandleInt(reference),
+                     (long long)num_total_row);
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetCreateFromSampledColumn(
+    double** sample_data, int** sample_indices, int32_t ncol,
+    const int32_t* num_per_col, int32_t num_sample_row,
+    int32_t num_local_row, int64_t num_dist_row, const char* parameters,
+    DatasetHandle* out) {
+  (void)num_dist_row;
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_create_from_sampled_column", "(LLiLiis)",
+                     (long long)(intptr_t)sample_data,
+                     (long long)(intptr_t)sample_indices, (int)ncol,
+                     (long long)(intptr_t)num_per_col, (int)num_sample_row,
+                     (int)num_local_row, parameters ? parameters : "");
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetInitStreaming(DatasetHandle dataset,
+                                       int32_t has_weights,
+                                       int32_t has_init_scores,
+                                       int32_t has_queries,
+                                       int32_t nclasses, int32_t nthreads,
+                                       int32_t omp_max_threads) {
+  (void)nthreads;
+  (void)omp_max_threads;
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_init_streaming", "(Liiii)",
+                           (long long)AsHandleInt(dataset),
+                           (int)has_weights, (int)has_init_scores,
+                           (int)has_queries, (int)nclasses));
+}
+
+LGBM_API int LGBM_DatasetPushRows(DatasetHandle dataset, const void* data,
+                                  int data_type, int32_t nrow,
+                                  int32_t ncol, int32_t start_row) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_push_rows", "(LLiiii)",
+                           (long long)AsHandleInt(dataset),
+                           (long long)(intptr_t)data, data_type, (int)nrow,
+                           (int)ncol, (int)start_row));
+}
+
+LGBM_API int LGBM_DatasetPushRowsWithMetadata(
+    DatasetHandle dataset, const void* data, int data_type, int32_t nrow,
+    int32_t ncol, int32_t start_row, const float* label,
+    const float* weight, const double* init_score, const int32_t* query,
+    int32_t tid) {
+  (void)tid;
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_push_rows_with_metadata", "(LLiiiiLLLL)",
+                           (long long)AsHandleInt(dataset),
+                           (long long)(intptr_t)data, data_type, (int)nrow,
+                           (int)ncol, (int)start_row,
+                           (long long)(intptr_t)label,
+                           (long long)(intptr_t)weight,
+                           (long long)(intptr_t)init_score,
+                           (long long)(intptr_t)query));
+}
+
+LGBM_API int LGBM_DatasetPushRowsByCSR(DatasetHandle dataset,
+                                       const void* indptr, int indptr_type,
+                                       const int32_t* indices,
+                                       const void* data, int data_type,
+                                       int64_t nindptr, int64_t nelem,
+                                       int64_t num_col, int64_t start_row) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_push_rows_by_csr", "(LLiLLiLLLL)",
+                           (long long)AsHandleInt(dataset),
+                           (long long)(intptr_t)indptr, indptr_type,
+                           (long long)(intptr_t)indices,
+                           (long long)(intptr_t)data, data_type,
+                           (long long)nindptr, (long long)nelem,
+                           (long long)num_col, (long long)start_row));
+}
+
+LGBM_API int LGBM_DatasetPushRowsByCSRWithMetadata(
+    DatasetHandle dataset, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t start_row, const float* label,
+    const float* weight, const double* init_score, const int32_t* query,
+    int32_t tid) {
+  (void)tid;
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(
+      Call("dataset_push_rows_by_csr_with_metadata", "(LLiLLiLLLLLLL)",
+           (long long)AsHandleInt(dataset), (long long)(intptr_t)indptr,
+           indptr_type, (long long)(intptr_t)indices,
+           (long long)(intptr_t)data, data_type, (long long)nindptr,
+           (long long)nelem, (long long)start_row,
+           (long long)(intptr_t)label, (long long)(intptr_t)weight,
+           (long long)(intptr_t)init_score, (long long)(intptr_t)query));
+}
+
+LGBM_API int LGBM_DatasetSetWaitForManualFinish(DatasetHandle dataset,
+                                                int wait) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_set_wait_for_manual_finish", "(Li)",
+                           (long long)AsHandleInt(dataset), wait));
+}
+
+LGBM_API int LGBM_DatasetMarkFinished(DatasetHandle dataset) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_mark_finished", "(L)",
+                           (long long)AsHandleInt(dataset)));
+}
+
+LGBM_API int LGBM_GetSampleCount(int32_t num_total_row,
+                                 const char* parameters, int* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("get_sample_count", "(is)", (int)num_total_row,
+                     parameters ? parameters : "");
+  if (r == nullptr) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_SampleIndices(int32_t num_total_row,
+                                const char* parameters, void* out,
+                                int32_t* out_len) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("sample_indices", "(isL)", (int)num_total_row,
+                     parameters ? parameters : "",
+                     (long long)(intptr_t)out);
+  if (r == nullptr) return -1;
+  *out_len = (int32_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// -- dataset field access / utilities --------------------------------------
+
+LGBM_API int LGBM_DatasetGetField(DatasetHandle handle,
+                                  const char* field_name, int* out_len,
+                                  const void** out_ptr, int* out_type) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_get_field", "(Ls)",
+                     (long long)AsHandleInt(handle), field_name);
+  if (r == nullptr) return -1;
+  long long ptr = 0;
+  int len = 0, code = 0;
+  if (!PyArg_ParseTuple(r, "Lii", &ptr, &len, &code)) {
+    PyErr_Clear();
+    Py_DECREF(r);
+    g_last_error = "bad tuple from dataset_get_field";
+    return -1;
+  }
+  Py_DECREF(r);
+  *out_ptr = reinterpret_cast<const void*>((intptr_t)ptr);
+  *out_len = len;
+  *out_type = code;
+  return 0;
+}
+
+namespace {
+// Copy a Python list of str into the (len, out_len, buffer_len,
+// out_buffer_len, out_strs) contract shared by the *GetFeatureNames /
+// GetEvalNames entry points (ref: c_api.cpp:2308).
+int CopyStringList(PyObject* list, const int len, int* out_len,
+                   const size_t buffer_len, size_t* out_buffer_len,
+                   char** out_strs) {
+  if (list == nullptr) return -1;
+  Py_ssize_t n = PyList_Size(list);
+  *out_len = (int)n;
+  size_t need = 1;
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* s = PyList_GetItem(list, i);  // borrowed
+    Py_ssize_t sz = 0;
+    const char* c = PyUnicode_AsUTF8AndSize(s, &sz);
+    if (c == nullptr) {
+      Py_DECREF(list);
+      g_last_error = "string encode failed";
+      return -1;
+    }
+    if ((size_t)(sz + 1) > need) need = (size_t)(sz + 1);
+    if (i < len && out_strs != nullptr) {
+      size_t ncopy = (size_t)sz + 1 <= buffer_len ? (size_t)sz + 1
+                                                  : buffer_len;
+      if (ncopy > 0 && out_strs[i] != nullptr) {
+        std::memcpy(out_strs[i], c, ncopy);
+        out_strs[i][ncopy - 1] = '\0';
+      }
+    }
+  }
+  *out_buffer_len = need;
+  Py_DECREF(list);
+  return 0;
+}
+}  // namespace
+
+LGBM_API int LGBM_DatasetGetFeatureNames(DatasetHandle handle,
+                                         const int len,
+                                         int* num_feature_names,
+                                         const size_t buffer_len,
+                                         size_t* out_buffer_len,
+                                         char** feature_names) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_get_feature_names", "(L)",
+                     (long long)AsHandleInt(handle));
+  return CopyStringList(r, len, num_feature_names, buffer_len,
+                        out_buffer_len, feature_names);
+}
+
+LGBM_API int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
+                                         const char** feature_names,
+                                         int num_feature_names) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* list = PyList_New(num_feature_names);
+  for (int i = 0; i < num_feature_names; ++i) {
+    PyList_SetItem(list, i, PyUnicode_FromString(feature_names[i]));
+  }
+  PyObject* r = Call("dataset_set_feature_names", "(LO)",
+                     (long long)AsHandleInt(handle), list);
+  Py_DECREF(list);
+  return HandleResult(r);
+}
+
+LGBM_API int LGBM_DatasetGetFeatureNumBin(DatasetHandle handle, int feature,
+                                          int* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_get_feature_num_bin", "(Li)",
+                     (long long)AsHandleInt(handle), feature);
+  if (r == nullptr) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetSaveBinary(DatasetHandle handle,
+                                    const char* filename) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_save_binary", "(Ls)",
+                           (long long)AsHandleInt(handle), filename));
+}
+
+LGBM_API int LGBM_DatasetDumpText(DatasetHandle handle,
+                                  const char* filename) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_dump_text", "(Ls)",
+                           (long long)AsHandleInt(handle), filename));
+}
+
+LGBM_API int LGBM_DatasetGetSubset(const DatasetHandle handle,
+                                   const int32_t* used_row_indices,
+                                   int32_t num_used_row_indices,
+                                   const char* parameters,
+                                   DatasetHandle* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_get_subset", "(LLis)",
+                     (long long)AsHandleInt(handle),
+                     (long long)(intptr_t)used_row_indices,
+                     (int)num_used_row_indices,
+                     parameters ? parameters : "");
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DatasetUpdateParamChecking(const char* old_parameters,
+                                             const char* new_parameters) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("dataset_update_param_checking", "(ss)",
+                           old_parameters ? old_parameters : "",
+                           new_parameters ? new_parameters : ""));
+}
+
+// -- booster extras --------------------------------------------------------
+
+LGBM_API int LGBM_BoosterLoadModelFromString(const char* model_str,
+                                             int* out_num_iterations,
+                                             BoosterHandle* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_load_model_from_string", "(s)", model_str);
+  if (r == nullptr) return -1;
+  long long handle = 0;
+  int iters = 0;
+  if (!PyArg_ParseTuple(r, "Li", &handle, &iters)) {
+    PyErr_Clear();
+    Py_DECREF(r);
+    g_last_error = "bad tuple from booster_load_model_from_string";
+    return -1;
+  }
+  Py_DECREF(r);
+  *out = reinterpret_cast<BoosterHandle>((intptr_t)handle);
+  *out_num_iterations = iters;
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterResetParameter(BoosterHandle handle,
+                                        const char* parameters) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_reset_parameter", "(Ls)",
+                           (long long)AsHandleInt(handle),
+                           parameters ? parameters : ""));
+}
+
+LGBM_API int LGBM_BoosterResetTrainingData(BoosterHandle handle,
+                                           const DatasetHandle train_data) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_reset_training_data", "(LL)",
+                           (long long)AsHandleInt(handle),
+                           (long long)AsHandleInt(train_data)));
+}
+
+LGBM_API int LGBM_BoosterRollbackOneIter(BoosterHandle handle) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_rollback_one_iter", "(L)",
+                           (long long)AsHandleInt(handle)));
+}
+
+namespace {
+int IntGetter(const char* fn, BoosterHandle handle, int* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call(fn, "(L)", (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+int DoubleGetter(const char* fn, BoosterHandle handle, double* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call(fn, "(L)", (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  *out = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+}  // namespace
+
+LGBM_API int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
+  return IntGetter("booster_get_num_classes", handle, out_len);
+}
+
+LGBM_API int LGBM_BoosterNumModelPerIteration(BoosterHandle handle,
+                                              int* out_tree_per_iteration) {
+  return IntGetter("booster_num_model_per_iteration", handle,
+                   out_tree_per_iteration);
+}
+
+LGBM_API int LGBM_BoosterNumberOfTotalModel(BoosterHandle handle,
+                                            int* out_models) {
+  return IntGetter("booster_number_of_total_model", handle, out_models);
+}
+
+LGBM_API int LGBM_BoosterGetLinear(BoosterHandle handle, int* out) {
+  return IntGetter("booster_get_linear", handle, out);
+}
+
+LGBM_API int LGBM_BoosterGetEvalNames(BoosterHandle handle, const int len,
+                                      int* out_len, const size_t buffer_len,
+                                      size_t* out_buffer_len,
+                                      char** out_strs) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_get_eval_names", "(L)",
+                     (long long)AsHandleInt(handle));
+  return CopyStringList(r, len, out_len, buffer_len, out_buffer_len,
+                        out_strs);
+}
+
+LGBM_API int LGBM_BoosterGetFeatureNames(BoosterHandle handle, const int len,
+                                         int* out_len,
+                                         const size_t buffer_len,
+                                         size_t* out_buffer_len,
+                                         char** out_strs) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_get_feature_names", "(L)",
+                     (long long)AsHandleInt(handle));
+  return CopyStringList(r, len, out_len, buffer_len, out_buffer_len,
+                        out_strs);
+}
+
+LGBM_API int LGBM_BoosterValidateFeatureNames(BoosterHandle handle,
+                                              const char** data_names,
+                                              int data_num_features) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* list = PyList_New(data_num_features);
+  for (int i = 0; i < data_num_features; ++i) {
+    PyList_SetItem(list, i, PyUnicode_FromString(data_names[i]));
+  }
+  PyObject* r = Call("booster_validate_feature_names", "(LO)",
+                     (long long)AsHandleInt(handle), list);
+  Py_DECREF(list);
+  return HandleResult(r);
+}
+
+LGBM_API int LGBM_BoosterCalcNumPredict(BoosterHandle handle, int num_row,
+                                        int predict_type,
+                                        int start_iteration,
+                                        int num_iteration,
+                                        int64_t* out_len) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_calc_num_predict", "(Liiii)",
+                     (long long)AsHandleInt(handle), num_row, predict_type,
+                     start_iteration, num_iteration);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetNumPredict(BoosterHandle handle, int data_idx,
+                                       int64_t* out_len) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_get_num_predict", "(Li)",
+                     (long long)AsHandleInt(handle), data_idx);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetPredict(BoosterHandle handle, int data_idx,
+                                    int64_t* out_len, double* out_result) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_get_predict", "(LiL)",
+                     (long long)AsHandleInt(handle), data_idx,
+                     (long long)(intptr_t)out_result);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterPredictForFile(BoosterHandle handle,
+                                        const char* data_filename,
+                                        int data_has_header,
+                                        int predict_type,
+                                        int start_iteration,
+                                        int num_iteration,
+                                        const char* parameter,
+                                        const char* result_filename) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_predict_for_file", "(Lsiiiiss)",
+                           (long long)AsHandleInt(handle), data_filename,
+                           data_has_header, predict_type, start_iteration,
+                           num_iteration, parameter ? parameter : "",
+                           result_filename));
+}
+
+LGBM_API int LGBM_BoosterDumpModel(BoosterHandle handle, int start_iteration,
+                                   int num_iteration,
+                                   int feature_importance_type,
+                                   int64_t buffer_len, int64_t* out_len,
+                                   char* out_str) {
+  (void)feature_importance_type;
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_dump_model", "(Lii)",
+                     (long long)AsHandleInt(handle), start_iteration,
+                     num_iteration);
+  if (r == nullptr) return -1;
+  Py_ssize_t size = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &size);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    g_last_error = "model dump encode failed";
+    return -1;
+  }
+  *out_len = (int64_t)size + 1;
+  if (buffer_len >= size + 1) {
+    std::memcpy(out_str, s, size + 1);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterFeatureImportance(BoosterHandle handle,
+                                           int num_iteration,
+                                           int importance_type,
+                                           double* out_results) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_feature_importance", "(LiiL)",
+                           (long long)AsHandleInt(handle), num_iteration,
+                           importance_type,
+                           (long long)(intptr_t)out_results));
+}
+
+LGBM_API int LGBM_BoosterGetLeafValue(BoosterHandle handle, int tree_idx,
+                                      int leaf_idx, double* out_val) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_get_leaf_value", "(Lii)",
+                     (long long)AsHandleInt(handle), tree_idx, leaf_idx);
+  if (r == nullptr) return -1;
+  *out_val = PyFloat_AsDouble(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterSetLeafValue(BoosterHandle handle, int tree_idx,
+                                      int leaf_idx, double val) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_set_leaf_value", "(Liid)",
+                           (long long)AsHandleInt(handle), tree_idx,
+                           leaf_idx, val));
+}
+
+LGBM_API int LGBM_BoosterGetUpperBoundValue(BoosterHandle handle,
+                                            double* out_results) {
+  return DoubleGetter("booster_get_upper_bound_value", handle, out_results);
+}
+
+LGBM_API int LGBM_BoosterGetLowerBoundValue(BoosterHandle handle,
+                                            double* out_results) {
+  return DoubleGetter("booster_get_lower_bound_value", handle, out_results);
+}
+
+LGBM_API int LGBM_BoosterShuffleModels(BoosterHandle handle, int start_iter,
+                                       int end_iter) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_shuffle_models", "(Lii)",
+                           (long long)AsHandleInt(handle), start_iter,
+                           end_iter));
+}
+
+LGBM_API int LGBM_BoosterMerge(BoosterHandle handle,
+                               BoosterHandle other_handle) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_merge", "(LL)",
+                           (long long)AsHandleInt(handle),
+                           (long long)AsHandleInt(other_handle)));
+}
+
+LGBM_API int LGBM_BoosterUpdateOneIterCustom(BoosterHandle handle,
+                                             const float* grad,
+                                             const float* hess,
+                                             int* is_finished) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_update_one_iter_custom", "(LLL)",
+                     (long long)AsHandleInt(handle),
+                     (long long)(intptr_t)grad, (long long)(intptr_t)hess);
+  if (r == nullptr) return -1;
+  *is_finished = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterRefit(BoosterHandle handle, const int32_t* leaf_preds,
+                               int32_t nrow, int32_t ncol) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("booster_refit", "(LLii)",
+                           (long long)AsHandleInt(handle),
+                           (long long)(intptr_t)leaf_preds, (int)nrow,
+                           (int)ncol));
+}
+
+// -- single-row / fast-path prediction (ref: c_api.cpp:2605-2625) ----------
+
+typedef void* FastConfigHandle;
+
+LGBM_API int LGBM_BoosterPredictForMatSingleRow(
+    BoosterHandle handle, const void* data, int data_type, int ncol,
+    int is_row_major, int predict_type, int start_iteration,
+    int num_iteration, const char* parameter, int64_t* out_len,
+    double* out_result) {
+  (void)is_row_major;
+  (void)parameter;
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_predict_for_mat_single_row", "(LLiiiiiL)",
+                     (long long)AsHandleInt(handle),
+                     (long long)(intptr_t)data, data_type, ncol,
+                     predict_type, start_iteration, num_iteration,
+                     (long long)(intptr_t)out_result);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterPredictForMatSingleRowFastInit(
+    BoosterHandle handle, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int32_t ncol,
+    const char* parameter, FastConfigHandle* out_fastConfig) {
+  (void)parameter;
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("fast_config_init", "(Liiiii)",
+                     (long long)AsHandleInt(handle), predict_type,
+                     start_iteration, num_iteration, data_type, (int)ncol);
+  if (r == nullptr) return -1;
+  *out_fastConfig =
+      reinterpret_cast<FastConfigHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterPredictForMatSingleRowFast(
+    FastConfigHandle fastConfig_handle, const void* data, int64_t* out_len,
+    double* out_result) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_predict_single_row_fast", "(LLL)",
+                     (long long)AsHandleInt(fastConfig_handle),
+                     (long long)(intptr_t)data,
+                     (long long)(intptr_t)out_result);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterPredictForCSRSingleRow(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col, int predict_type,
+    int start_iteration, int num_iteration, const char* parameter,
+    int64_t* out_len, double* out_result) {
+  (void)parameter;
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_predict_csr_single_row", "(LLiLLiLLLiiiL)",
+                     (long long)AsHandleInt(handle),
+                     (long long)(intptr_t)indptr, indptr_type,
+                     (long long)(intptr_t)indices,
+                     (long long)(intptr_t)data, data_type,
+                     (long long)nindptr, (long long)nelem,
+                     (long long)num_col, predict_type, start_iteration,
+                     num_iteration, (long long)(intptr_t)out_result);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterPredictForCSRSingleRowFastInit(
+    BoosterHandle handle, const int predict_type, const int start_iteration,
+    const int num_iteration, const int data_type, const int64_t num_col,
+    const char* parameter, FastConfigHandle* out_fastConfig) {
+  (void)parameter;
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("fast_config_init", "(Liiiii)",
+                     (long long)AsHandleInt(handle), predict_type,
+                     start_iteration, num_iteration, data_type,
+                     (int)num_col);
+  if (r == nullptr) return -1;
+  *out_fastConfig =
+      reinterpret_cast<FastConfigHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterPredictForCSRSingleRowFast(
+    FastConfigHandle fastConfig_handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int64_t nindptr, int64_t nelem,
+    int64_t* out_len, double* out_result) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_predict_csr_single_row_fast", "(LLiLLLLL)",
+                     (long long)AsHandleInt(fastConfig_handle),
+                     (long long)(intptr_t)indptr, indptr_type,
+                     (long long)(intptr_t)indices,
+                     (long long)(intptr_t)data, (long long)nindptr,
+                     (long long)nelem, (long long)(intptr_t)out_result);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_FastConfigFree(FastConfigHandle fastConfig) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("handle_free", "(L)",
+                           (long long)AsHandleInt(fastConfig)));
+}
+
+LGBM_API int LGBM_BoosterPredictForMats(BoosterHandle handle,
+                                        const void** data, int data_type,
+                                        int32_t nrow, int32_t ncol,
+                                        int predict_type,
+                                        int start_iteration,
+                                        int num_iteration,
+                                        const char* parameter,
+                                        int64_t* out_len,
+                                        double* out_result) {
+  (void)parameter;
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_predict_for_mats", "(LLiiiiiiL)",
+                     (long long)AsHandleInt(handle),
+                     (long long)(intptr_t)data, data_type, (int)nrow,
+                     (int)ncol, predict_type, start_iteration,
+                     num_iteration, (long long)(intptr_t)out_result);
+  if (r == nullptr) return -1;
+  *out_len = (int64_t)PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+// -- global utilities ------------------------------------------------------
+
+LGBM_API int LGBM_SetMaxThreads(int num_threads) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("set_max_threads", "(i)", num_threads));
+}
+
+LGBM_API int LGBM_GetMaxThreads(int* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("get_max_threads", "()");
+  if (r == nullptr) return -1;
+  *out = (int)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_DumpParamAliases(int64_t buffer_len, int64_t* out_len,
+                                   char* out_str) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dump_param_aliases", "()");
+  if (r == nullptr) return -1;
+  Py_ssize_t size = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &size);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    g_last_error = "alias dump encode failed";
+    return -1;
+  }
+  *out_len = (int64_t)size + 1;
+  if (buffer_len >= size + 1) {
+    std::memcpy(out_str, s, size + 1);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_RegisterLogCallback(void (*callback)(const char*)) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("register_log_callback", "(L)",
+                           (long long)(intptr_t)callback));
+}
+
+LGBM_API int LGBM_NetworkInit(const char* machines, int local_listen_port,
+                              int listen_time_out, int num_machines) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("network_init", "(siii)",
+                           machines ? machines : "", local_listen_port,
+                           listen_time_out, num_machines));
+}
+
+LGBM_API int LGBM_NetworkFree() {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("network_free", "()"));
 }

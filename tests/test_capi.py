@@ -282,3 +282,293 @@ def test_capi_external_c_program(tmp_path):
                           text=True, timeout=600)
     assert proc.returncode == 0, proc.stderr
     assert "C-API-OK" in proc.stdout
+
+
+class TestCApiStreaming:
+    """The reference streaming flow (ref: tests/cpp_tests/test_stream.cpp
+    :253 PushDenseRowsWithMetadata, :304 PushSparseRowsWithMetadata):
+    schema from sampled columns -> InitStreaming -> concurrent-style
+    chunked pushes with metadata -> MarkFinished -> train."""
+
+    def _sampled_schema(self, lib, X, params=b"max_bin=63"):
+        n, f = X.shape
+        cols = [np.ascontiguousarray(X[:, j], np.float64) for j in range(f)]
+        idxs = [np.arange(n, dtype=np.int32) for _ in range(f)]
+        dptrs = (ctypes.c_void_p * f)(
+            *[c.ctypes.data for c in cols])
+        iptrs = (ctypes.c_void_p * f)(
+            *[ix.ctypes.data for ix in idxs])
+        npc = np.full(f, n, np.int32)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromSampledColumn(
+            dptrs, iptrs, ctypes.c_int32(f),
+            npc.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(n), ctypes.c_int32(n), ctypes.c_int64(n),
+            params, ctypes.byref(ds)))
+        # keep the per-column buffers alive until the call returns
+        self._keep = (cols, idxs)
+        return ds
+
+    def test_stream_dense_with_metadata(self, lib):
+        X, y = make_binary(400, 6)
+        X64 = np.ascontiguousarray(X, np.float64)
+        lab = np.ascontiguousarray(y, np.float32)
+        w = np.ones(400, np.float32)
+        ds = self._sampled_schema(lib, X64)
+        _check(lib, lib.LGBM_DatasetInitStreaming(
+            ds, 1, 0, 0, 1, 1, -1))
+        # push in 4 chunks of 100 (the reference pushes per-thread blocks)
+        for k in range(4):
+            s = k * 100
+            _check(lib, lib.LGBM_DatasetPushRowsWithMetadata(
+                ds, X64[s:s + 100].ctypes.data_as(ctypes.c_void_p), 1,
+                ctypes.c_int32(100), ctypes.c_int32(6), ctypes.c_int32(s),
+                lab[s:s + 100].ctypes.data_as(
+                    ctypes.POINTER(ctypes.c_float)),
+                w[s:s + 100].ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                None, None, ctypes.c_int32(0)))
+        _check(lib, lib.LGBM_DatasetMarkFinished(ds))
+
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+        assert n.value == 400
+
+        # the streamed dataset trains like a directly-created one
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=15 min_data_in_leaf=5 "
+                b"verbosity=-1", ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(8):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst,
+                                                      ctypes.byref(fin)))
+        out = (ctypes.c_double * 400)()
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, X64.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(400), ctypes.c_int32(6), 1, 0, 0, -1, b"",
+            ctypes.byref(out_len), out))
+        pred = np.asarray(out[:400])
+        assert pred[y > 0.5].mean() - pred[y <= 0.5].mean() > 0.2
+        # label round-trips through GetField
+        fptr = ctypes.c_void_p()
+        flen = ctypes.c_int()
+        ftype = ctypes.c_int()
+        _check(lib, lib.LGBM_DatasetGetField(
+            ds, b"label", ctypes.byref(flen), ctypes.byref(fptr),
+            ctypes.byref(ftype)))
+        assert flen.value == 400 and ftype.value == 0
+        got = np.ctypeslib.as_array(
+            ctypes.cast(fptr, ctypes.POINTER(ctypes.c_float)),
+            shape=(400,))
+        np.testing.assert_array_equal(got, lab)
+        _check(lib, lib.LGBM_BoosterFree(bst))
+        _check(lib, lib.LGBM_DatasetFree(ds))
+
+    def test_stream_csr_auto_finish(self, lib):
+        """PushRowsByCSR without manual finish: dataset finishes itself
+        when the pushed rows reach num_total_row (ref: c_api.h:221)."""
+        from scipy import sparse
+        rng = np.random.RandomState(3)
+        X = rng.randn(300, 5)
+        X[rng.rand(300, 5) < 0.5] = 0.0
+        y = (X[:, 0] > 0).astype(np.float32)
+        X64 = np.ascontiguousarray(X, np.float64)
+
+        ds = self._sampled_schema(lib, X64)
+        half = 150
+        for s in (0, half):
+            csr = sparse.csr_matrix(X64[s:s + half])
+            indptr = np.ascontiguousarray(csr.indptr, np.int32)
+            indices = np.ascontiguousarray(csr.indices, np.int32)
+            vals = np.ascontiguousarray(csr.data, np.float64)
+            _check(lib, lib.LGBM_DatasetPushRowsByCSR(
+                ds, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+                indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                vals.ctypes.data_as(ctypes.c_void_p), 1,
+                ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+                ctypes.c_int64(5), ctypes.c_int64(s)))
+        # auto-finished: SetField + train must work without MarkFinished
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(300), 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=7 verbosity=-1",
+            ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+        _check(lib, lib.LGBM_BoosterFree(bst))
+        _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+class TestCApiExtendedSurface:
+    @pytest.fixture()
+    def trained(self, lib):
+        X, y = make_binary(400, 6)
+        X64 = np.ascontiguousarray(X, np.float64)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X64.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(400),
+            ctypes.c_int32(6), 1, b"max_bin=63", None, ctypes.byref(ds)))
+        y32 = np.ascontiguousarray(y, np.float32)
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y32.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(400), 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=15 min_data_in_leaf=5 "
+                b"verbosity=-1 learning_rate=0.1", ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(6):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst,
+                                                      ctypes.byref(fin)))
+        yield lib, ds, bst, X64, y
+        lib.LGBM_BoosterFree(bst)
+        lib.LGBM_DatasetFree(ds)
+
+    def test_reset_parameter_and_rollback(self, trained):
+        lib, ds, bst, X64, y = trained
+        _check(lib, lib.LGBM_BoosterResetParameter(
+            bst, b"learning_rate=0.01"))
+        fin = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+        it = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst,
+                                                        ctypes.byref(it)))
+        assert it.value == 7
+        _check(lib, lib.LGBM_BoosterRollbackOneIter(bst))
+        _check(lib, lib.LGBM_BoosterGetCurrentIteration(bst,
+                                                        ctypes.byref(it)))
+        assert it.value == 6
+
+    def test_counts_and_bounds(self, trained):
+        lib, ds, bst, X64, y = trained
+        n = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterGetNumClasses(bst, ctypes.byref(n)))
+        assert n.value == 1
+        _check(lib, lib.LGBM_BoosterNumModelPerIteration(bst,
+                                                         ctypes.byref(n)))
+        assert n.value == 1
+        _check(lib, lib.LGBM_BoosterNumberOfTotalModel(bst,
+                                                       ctypes.byref(n)))
+        assert n.value == 6
+        lo = ctypes.c_double()
+        hi = ctypes.c_double()
+        _check(lib, lib.LGBM_BoosterGetLowerBoundValue(bst,
+                                                       ctypes.byref(lo)))
+        _check(lib, lib.LGBM_BoosterGetUpperBoundValue(bst,
+                                                       ctypes.byref(hi)))
+        assert lo.value < hi.value
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterCalcNumPredict(
+            bst, 100, 0, 0, -1, ctypes.byref(out_len)))
+        assert out_len.value == 100
+        _check(lib, lib.LGBM_BoosterCalcNumPredict(
+            bst, 100, 3, 0, -1, ctypes.byref(out_len)))  # contrib
+        assert out_len.value == 100 * 7
+
+    def test_eval_and_feature_names(self, trained):
+        lib, ds, bst, X64, y = trained
+        nbuf = 16
+        buflen = 64
+        bufs = [ctypes.create_string_buffer(buflen) for _ in range(nbuf)]
+        arr = (ctypes.c_char_p * nbuf)(
+            *[ctypes.cast(b, ctypes.c_char_p) for b in bufs])
+        out_n = ctypes.c_int()
+        out_sz = ctypes.c_size_t()
+        _check(lib, lib.LGBM_BoosterGetFeatureNames(
+            bst, nbuf, ctypes.byref(out_n), ctypes.c_size_t(buflen),
+            ctypes.byref(out_sz), ctypes.cast(arr, ctypes.POINTER(
+                ctypes.c_char_p))))
+        assert out_n.value == 6
+        assert bufs[0].value.decode().startswith("Column_")
+        _check(lib, lib.LGBM_BoosterGetEvalNames(
+            bst, nbuf, ctypes.byref(out_n), ctypes.c_size_t(buflen),
+            ctypes.byref(out_sz), ctypes.cast(arr, ctypes.POINTER(
+                ctypes.c_char_p))))
+        assert out_n.value >= 1
+
+    def test_leaf_value_surgery(self, trained):
+        lib, ds, bst, X64, y = trained
+        v = ctypes.c_double()
+        _check(lib, lib.LGBM_BoosterGetLeafValue(bst, 0, 0,
+                                                 ctypes.byref(v)))
+        _check(lib, lib.LGBM_BoosterSetLeafValue(
+            bst, 0, 0, ctypes.c_double(v.value + 1.0)))
+        v2 = ctypes.c_double()
+        _check(lib, lib.LGBM_BoosterGetLeafValue(bst, 0, 0,
+                                                 ctypes.byref(v2)))
+        assert abs(v2.value - v.value - 1.0) < 1e-12
+
+    def test_fast_single_row_predict(self, trained):
+        lib, ds, bst, X64, y = trained
+        out = (ctypes.c_double * 400)()
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, X64.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(400), ctypes.c_int32(6), 1, 0, 0, -1, b"",
+            ctypes.byref(out_len), out))
+        fc = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterPredictForMatSingleRowFastInit(
+            bst, 0, 0, -1, 1, ctypes.c_int32(6), b"", ctypes.byref(fc)))
+        single = (ctypes.c_double * 1)()
+        for i in (0, 7, 123):
+            row = np.ascontiguousarray(X64[i])
+            _check(lib, lib.LGBM_BoosterPredictForMatSingleRowFast(
+                fc, row.ctypes.data_as(ctypes.c_void_p),
+                ctypes.byref(out_len), single))
+            assert abs(single[0] - out[i]) < 1e-10
+        _check(lib, lib.LGBM_FastConfigFree(fc))
+
+    def test_predict_for_file(self, trained, tmp_path):
+        lib, ds, bst, X64, y = trained
+        data_file = tmp_path / "data.csv"
+        lines = ["\t".join(str(v) for v in [0.0] + list(row))
+                 for row in X64[:50]]
+        data_file.write_text("\n".join(lines) + "\n")
+        result_file = tmp_path / "preds.txt"
+        _check(lib, lib.LGBM_BoosterPredictForFile(
+            bst, str(data_file).encode(), 0, 0, 0, -1, b"",
+            str(result_file).encode()))
+        preds = np.array([float(l) for l in
+                          result_file.read_text().splitlines()])
+        out = (ctypes.c_double * 50)()
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, np.ascontiguousarray(X64[:50]).ctypes.data_as(
+                ctypes.c_void_p), 1,
+            ctypes.c_int32(50), ctypes.c_int32(6), 1, 0, 0, -1, b"",
+            ctypes.byref(out_len), out))
+        np.testing.assert_allclose(preds, np.asarray(out[:50]),
+                                   rtol=1e-6, atol=1e-8)
+
+    def test_load_model_from_string_and_merge(self, trained):
+        lib, ds, bst, X64, y = trained
+        buf_len = 1 << 20
+        buf = ctypes.create_string_buffer(buf_len)
+        str_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterSaveModelToString(
+            bst, 0, -1, 0, ctypes.c_int64(buf_len), ctypes.byref(str_len),
+            buf))
+        loaded = ctypes.c_void_p()
+        iters = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterLoadModelFromString(
+            buf.value, ctypes.byref(iters), ctypes.byref(loaded)))
+        assert iters.value == 6
+        _check(lib, lib.LGBM_BoosterFree(loaded))
+
+    def test_global_utilities(self, lib):
+        n = ctypes.c_int()
+        _check(lib, lib.LGBM_SetMaxThreads(4))
+        _check(lib, lib.LGBM_GetMaxThreads(ctypes.byref(n)))
+        assert n.value == 4
+        _check(lib, lib.LGBM_SetMaxThreads(-1))
+        buf_len = 1 << 20
+        buf = ctypes.create_string_buffer(buf_len)
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_DumpParamAliases(
+            ctypes.c_int64(buf_len), ctypes.byref(out_len), buf))
+        assert b"num_iterations" in buf.value
+        _check(lib, lib.LGBM_NetworkInit(b"127.0.0.1:12400", 12400, 120, 1))
+        _check(lib, lib.LGBM_NetworkFree())
