@@ -19,8 +19,30 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+# Quick tier (VERDICT r4 #9): `pytest -m quick` runs a <=15-min subset —
+# one config per family + the semantics/unit tests — so verification
+# stops competing with development; the full 2h+ grid stays the default
+# `pytest tests/` (plus LGBM_TPU_FULL_CONSISTENCY=1 for the stochastic
+# tier). Membership is per-module: every test in these files is cheap.
+QUICK_FILES = {
+    "test_binning.py", "test_bundling.py", "test_sparse.py",
+    "test_native.py", "test_param_honesty.py", "test_objectives.py",
+    "test_metrics.py", "test_model_io.py", "test_learner.py",
+    "test_booster_surface.py", "test_ingestion.py", "test_waved.py",
+}
+
+
 def pytest_configure(config):
     config.addinivalue_line("markers", "slow: long-running test")
+    config.addinivalue_line(
+        "markers", "quick: <=15-min verification tier (see QUICK_FILES)")
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest as _pytest
+    for item in items:
+        if os.path.basename(str(item.fspath)) in QUICK_FILES:
+            item.add_marker(_pytest.mark.quick)
 
 
 @pytest.fixture
