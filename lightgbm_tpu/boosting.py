@@ -105,6 +105,19 @@ class GBDT:
         self._bundle = train_set.device_bundle()
         self._num_bundle_bins = (train_set.bundle_info.num_bundle_bins
                                  if train_set.bundle_info is not None else 0)
+        # sparse row-wise COO storage (multi_val_sparse_bin.hpp analog):
+        # bins_fm is then a SparseBins pytree, histogram passes run
+        # O(nnz) segment-sums
+        self._sparse_shape = None
+        self._quant_enabled = bool(config.use_quantized_grad)
+        if train_set.sparse_coo is not None:
+            self._sparse_shape = (train_set.num_features,
+                                  train_set.num_data)
+            if self._quant_enabled:
+                import warnings
+                warnings.warn("use_quantized_grad is not supported with "
+                              "sparse COO histograms; using f32")
+                self._quant_enabled = False
         num_bins, missing, default_bin, is_cat = \
             train_set.feature_meta_arrays()
         mono = np.zeros(train_set.num_features, np.int8)
@@ -282,11 +295,25 @@ class GBDT:
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
 
+    def _resolved_wave_max(self) -> int:
+        """tpu_wave_max with -1 (auto) resolved: exact order for softmax
+        multiclass (cross-class coupling makes split order
+        calibration-critical — see the knob's docstring in config.py),
+        waved elsewhere. multiclassova's per-class trees are independent
+        binary fits, so OVA keeps the waved default."""
+        wm = int(self.config.tpu_wave_max)
+        if wm >= 0:
+            return wm
+        obj_name = getattr(self.objective, "name", "")
+        coupled = (self.num_tree_per_iteration > 1
+                   and obj_name != "multiclassova")
+        return 0 if coupled else 42
+
     def _use_waved(self) -> bool:
         """Waved growth batches histogram builds of many splits into one
         multi-leaf pass (learner.grow_tree_waved); forced splits need the
         exact per-split grower."""
-        return self.config.tpu_wave_max > 0 and self._forced is None
+        return self._resolved_wave_max() > 0 and self._forced is None
 
     def _grow_fn(self):
         return grow_tree_waved if self._use_waved() else grow_tree
@@ -294,10 +321,12 @@ class GBDT:
     def _grow_kwargs(self):
         kw = dict(self._static)
         if self._use_waved():
-            kw["wave_max"] = int(self.config.tpu_wave_max)
+            kw["wave_max"] = self._resolved_wave_max()
         if self._bundle is not None:
             kw["bundle"] = self._bundle
             kw["num_bundle_bins"] = self._num_bundle_bins
+        if self._sparse_shape is not None:
+            kw["sparse_shape"] = self._sparse_shape
         return kw
 
     # ------------------------------------------------------------------
@@ -470,7 +499,7 @@ class GBDT:
             grad, hess = grad * scale, hess * scale
         true_grad, true_hess = grad, hess
         quant = None
-        if self.config.use_quantized_grad:
+        if self._quant_enabled:
             grad, hess, quant = self._discretize_in_jit(
                 jax.random.fold_in(key, 300 + k), grad, hess)
         fmask = self._feature_mask_in_jit(
@@ -492,7 +521,7 @@ class GBDT:
                              self.feature_meta, self.hp,
                              self.max_depth, self._forced,
                              node_key, **grow_kw)
-        if self.config.use_quantized_grad and \
+        if self._quant_enabled and \
                 self.config.quant_train_renew_leaf:
             rec = self._renew_leaves_in_jit(
                 rec, row_leaf, true_grad, true_hess, mask)
@@ -535,8 +564,10 @@ class GBDT:
                                           rec.leaf_value * lr, 0.0)
                     scores = scores.at[k].add(leaf_vals[row_leaf])
                     for vi in range(len(valid_bins)):
-                        vleaf = replay_tree(rec, valid_bins[vi],
-                                            self.feature_meta, self._bundle)
+                        vleaf = replay_tree(
+                            rec, valid_bins[vi], self.feature_meta,
+                            self._bundle,
+                            num_data=self._valid_sets[vi][0].num_data)
                         new_valid[vi] = new_valid[vi].at[k].add(
                             leaf_vals[vleaf])
                     recs.append(rec)
@@ -728,7 +759,7 @@ class GBDT:
                 mask, scale = self._goss_mask(grad, hess)
                 grad, hess = grad * scale, hess * scale
             true_grad, true_hess = grad, hess
-            if self.config.use_quantized_grad:
+            if self._quant_enabled:
                 qkey = jax.random.fold_in(self._bagging_key,
                                           self.iter + (3 << 20) + k)
                 grad, hess, _quant = self._discretize_in_jit(qkey, grad, hess)
@@ -742,7 +773,7 @@ class GBDT:
                 self.bins_fm, grad, hess, mask, feature_mask,
                 self.feature_meta, self.hp, self.max_depth, self._forced,
                 node_key)
-            if self.config.use_quantized_grad and \
+            if self._quant_enabled and \
                     self.config.quant_train_renew_leaf:
                 record = self._renew_leaves_in_jit(
                     record, row_leaf, true_grad, true_hess, mask)
@@ -949,6 +980,13 @@ class GBDT:
         """Leaf index per train row using the binned matrix."""
         bins = self.train_set.bins_fm
         n = bins.shape[1]
+        sparse_cols = None
+        if self.train_set.sparse_coo is not None:
+            # COO storage: materialize only the tree's split features
+            uniq = np.unique(np.asarray(
+                tree.split_feature_inner[:tree.num_internal], np.int64))
+            sparse_cols = {int(ff): self.train_set.host_feature_bins(
+                int(ff)) for ff in uniq}
         node = np.zeros(n, np.int32)
         out = np.zeros(n, np.int32)
         if tree.num_internal == 0:
@@ -979,7 +1017,12 @@ class GBDT:
             active = np.flatnonzero(~done)
             nd = node[active]
             feat = tree.split_feature_inner[nd]
-            if bi is None:
+            if sparse_cols is not None:
+                b = np.empty(len(active), np.int32)
+                for ff in np.unique(feat):
+                    m = feat == ff
+                    b[m] = sparse_cols[int(ff)][active[m]]
+            elif bi is None:
                 b = bins[feat, active].astype(np.int32)
             else:  # EFB decode
                 from .bundling import decode_stored_host
@@ -1344,8 +1387,10 @@ class DART(GBDT):
                             init_vec[k] / new_factor, 0.0)
                     leaf_vals = leaf_vals.at[t_cur, k].set(lv_store)
                     for vi in range(len(valid_bins)):
-                        vleaf = replay_tree(rec, valid_bins[vi],
-                                            self.feature_meta, self._bundle)
+                        vleaf = replay_tree(
+                            rec, valid_bins[vi], self.feature_meta,
+                            self._bundle,
+                            num_data=self._valid_sets[vi][0].num_data)
                         new_valid[vi] = new_valid[vi].at[k].set(
                             new_valid[vi][k]
                             - (1.0 - old_factor) * deltas_v[vi][k]

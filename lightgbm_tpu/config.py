@@ -172,6 +172,7 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_wave_max": [],
     "tpu_hist_precision": [],
     "tpu_hist_impl": [],
+    "tpu_sparse_hist": [],
     "tpu_dart_fused_max_bytes": [],
 }
 
@@ -436,16 +437,23 @@ class Config:
     # splits into one multi-leaf pass (0 = exact per-split builds).
     # Wave sizes follow a frontier-proportional schedule — see
     # learner._wave_schedule — so early splits stay near-exact; the cap
-    # only bounds the LATE waves. Default 42 = the multi-leaf kernel's
-    # slot count (128 MXU lanes // 3 channels); ~13 full-data histogram
+    # only bounds the LATE waves. 42 = the multi-leaf kernel's slot
+    # count (128 MXU lanes // 3 channels); ~13 full-data histogram
     # passes per 255-leaf tree instead of 254, at quality parity on
     # binary/regression/ranking (tests/test_waved.py; parity-gated vs
-    # the reference in tests/test_consistency.py's waved tier). Known
-    # envelope: multiclass softmax logloss CALIBRATION drifts (~+0.13
-    # on the reference multiclass example at 31 leaves) while auc_mu
-    # ordering stays better than the reference; set tpu_wave_max=0 for
-    # exact reference-grade multiclass calibration.
-    tpu_wave_max: int = 42
+    # the reference in tests/test_consistency.py's waved tier).
+    #
+    # Default -1 = AUTO: 42 for single-output models, 0 (exact) for
+    # multiclass. Measured (round 5): the waved code path at wave size 1
+    # is BIT-IDENTICAL to the exact grower, but any batching >= 2
+    # perturbs softmax split order enough to drift multiclass logloss
+    # calibration +0.08..+0.13 on the reference multiclass example
+    # (auc_mu ordering stays better than the reference throughout) —
+    # softmax's cross-class coupling makes tree structure order-critical,
+    # so multiclass defaults to exact order. Set tpu_wave_max=42
+    # explicitly to trade that calibration for ~20x fewer histogram
+    # passes on large multiclass data.
+    tpu_wave_max: int = -1
     # MXU precision of the histogram one-hot contraction: "default" =
     # single bf16 pass with f32 accumulation (the one-hot operand is
     # exact in bf16; the grad/hess operand is rounded to 8 mantissa
@@ -460,6 +468,11 @@ class Config:
     # (pallas on CPU runs in interpret mode — tests use this to exercise
     # the kernel + its shard_map mesh wrapper without a chip)
     tpu_hist_impl: str = "auto"
+    # sparse row-wise COO histograms for ultra-sparse non-bundleable
+    # input (ref: multi_val_sparse_bin.hpp:21): "auto" picks COO when
+    # the estimated O(nnz) segment-sum work beats the dense/EFB layout,
+    # "force"/"off" override. Serial tree learner only.
+    tpu_sparse_hist: str = "auto"
     # DART fused-path budget: the per-tree leaf-assignment history
     # ([T, K, N] device buffer that lets dropped-tree contributions be
     # recomputed without host round-trips) is only kept below this many

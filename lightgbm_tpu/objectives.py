@@ -634,28 +634,51 @@ class LambdarankNDCG(_RankingObjective):
 
     def get_gradients(self, score):
         """Pairwise lambdarank over padded queries
-        (ref: rank_objective.hpp:139 GetGradientsForOneQuery)."""
+        (ref: rank_objective.hpp:139 GetGradientsForOneQuery). Faithful
+        to the reference's pair rule and normalizations:
+          - a pair participates iff the better-SCORED doc ranks inside
+            truncation_level; both docs keep their TRUE rank discounts
+          - with lambdarank_norm, delta_NDCG is regularized by the score
+            distance (/(0.01 + |ds|)) when the query's scores are not
+            all equal, and the final per-query scale is
+            log2(1 + sum_pair 2|lambda|) / sum_pair 2|lambda|
+        """
         sig = self.config.sigmoid
         s_pad = self._adjusted_score(score)[self.pad_idx]  # [Q, S]
         s_pad = jnp.where(self.pad_mask > 0, s_pad, -jnp.inf)
         lab = self.label_np_pad_int()
         gain = self.label_gain[lab] * self.pad_mask  # [Q, S]
 
-        # rank of each doc by score (descending) within query
-        order = jnp.argsort(-s_pad, axis=1)
-        ranks = jnp.argsort(order, axis=1)  # 0-based position
+        # rank of each doc by score (descending) within query; stable,
+        # like the reference's std::stable_sort
+        order = jnp.argsort(-s_pad, axis=1, stable=True)
+        ranks = jnp.argsort(order, axis=1, stable=True)  # 0-based position
         disc = 1.0 / jnp.log2(ranks.astype(jnp.float32) + 2.0)
-        disc = jnp.where(ranks < self.trunc, disc, 0.0)  # truncation level
 
         sd = s_pad[:, :, None] - s_pad[:, None, :]        # s_i - s_j
         sd = jnp.where(jnp.isfinite(sd), sd, 0.0)
         lab_d = lab[:, :, None] - lab[:, None, :]
         better = (lab_d > 0).astype(jnp.float32)          # i truly better than j
-        pair_m = (self.pad_mask[:, :, None] * self.pad_mask[:, None, :]) * better
-        # |delta NDCG| for swapping i,j
+        # truncation: the better-SCORED doc of the pair must rank inside
+        # truncation_level (ref: the i < truncation_level loop bound)
+        top = (jnp.minimum(ranks[:, :, None], ranks[:, None, :])
+               < self.trunc).astype(jnp.float32)
+        pair_m = (self.pad_mask[:, :, None] * self.pad_mask[:, None, :]
+                  * better * top)
+        # |delta NDCG| for swapping i,j — TRUE discounts for both ranks
         dgain = gain[:, :, None] - gain[:, None, :]
         ddisc = disc[:, :, None] - disc[:, None, :]
         delta = jnp.abs(dgain * ddisc) * self.inv_max_dcg[:, None, None]
+
+        if self.config.lambdarank_norm:
+            # regularize by score distance unless the query's scores are
+            # all equal (ref: norm_ && best_score != worst_score)
+            s_valid_max = jnp.max(jnp.where(self.pad_mask > 0, s_pad,
+                                            -jnp.inf), axis=1)
+            s_valid_min = jnp.min(jnp.where(self.pad_mask > 0, s_pad,
+                                            jnp.inf), axis=1)
+            spread = (s_valid_max != s_valid_min)[:, None, None]
+            delta = jnp.where(spread, delta / (0.01 + jnp.abs(sd)), delta)
 
         rho = jax.nn.sigmoid(-sig * sd)                   # prob j beats i
         lam = -sig * rho * delta * pair_m                 # grad wrt s_i (i better)
@@ -665,14 +688,15 @@ class LambdarankNDCG(_RankingObjective):
         hess_pad = jnp.sum(lam_h, axis=2) + jnp.sum(lam_h, axis=1)
 
         if self.config.lambdarank_norm:
-            norm = jnp.sum(jnp.abs(grad_pad) * self.pad_mask, axis=1,
-                           keepdims=True)
-            cnt = jnp.sum(self.pad_mask, axis=1, keepdims=True)
-            scale = jnp.where(norm > 0, jnp.log2(1.0 + norm) / jnp.maximum(
-                norm, 1e-20), 1.0)
+            # sum over pairs of 2|lambda| (ref: sum_lambdas -= 2*p_lambda)
+            sum_lambdas = -2.0 * jnp.sum(lam, axis=(1, 2), keepdims=False)
+            scale = jnp.where(
+                sum_lambdas > 0,
+                jnp.log2(1.0 + sum_lambdas) / jnp.maximum(sum_lambdas,
+                                                          1e-20),
+                1.0)[:, None]
             grad_pad = grad_pad * scale
             hess_pad = hess_pad * scale
-            del cnt
         grad, hess = self._scatter_back(grad_pad, hess_pad)
         # per-row weights scale the final lambdas
         # (ref: rank_objective.hpp:80-86)
@@ -686,28 +710,54 @@ class LambdarankNDCG(_RankingObjective):
 
 class RankXENDCG(_RankingObjective):
     name = "rank_xendcg"
+    # the per-iteration gamma-sampling key evolves through the fused
+    # program like pos_biases does
+    _evolving_attrs = ("pos_biases", "xendcg_key")
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        self._rng = np.random.RandomState(self.config.objective_seed)
+        self.xendcg_key = jax.random.PRNGKey(self.config.objective_seed)
         lab = np.asarray(self.label_pad)
-        self.phi_gain = jnp.asarray((2.0 ** lab - 1.0) *
-                                    np.asarray(self.pad_mask))
+        self._pow2_label = jnp.asarray((2.0 ** np.floor(lab)) *
+                                       np.asarray(self.pad_mask))
 
     def get_gradients(self, score):
-        """Cross-entropy surrogate for NDCG
-        (ref: rank_objective.hpp:385 RankXENDCG::GetGradientsForOneQuery)."""
+        """Cross-entropy surrogate for NDCG, arxiv.org/abs/1911.09798
+        (ref: rank_objective.hpp:396 RankXENDCG::GetGradientsForOneQuery).
+        Faithful to the reference's estimator: the ground-truth
+        distribution is sampled — Phi(l, g) = 2^l - g with g ~ U(0,1)
+        fresh each iteration — and the gradient includes the second- and
+        third-order correction terms of the XE-NDCG mean loss."""
         s_pad = self._adjusted_score(score)[self.pad_idx]
         neg_inf = jnp.finfo(s_pad.dtype).min
         s_masked = jnp.where(self.pad_mask > 0, s_pad, neg_inf)
         rho = jax.nn.softmax(s_masked, axis=1) * self.pad_mask  # [Q, S]
 
-        gsum = jnp.sum(self.phi_gain, axis=1, keepdims=True)
-        phi = self.phi_gain / jnp.maximum(gsum, 1e-20)
+        self.xendcg_key, sub = jax.random.split(self.xendcg_key)
+        g = jax.random.uniform(sub, self.pad_mask.shape)
+        params = (self._pow2_label - g) * self.pad_mask  # Phi(l, g)
+        eps = 1e-15  # kEpsilon (ref: meta.h:55)
+        inv_den = 1.0 / jnp.maximum(
+            jnp.sum(params, axis=1, keepdims=True), eps)
 
-        # first/second order terms of the XE-NDCG loss
-        grad_pad = (rho - phi) * self.pad_mask
+        # first-order terms
+        term1 = (-params * inv_den + rho) * self.pad_mask
+        one_minus_rho = jnp.maximum(1.0 - rho, eps)
+        p2 = term1 / one_minus_rho
+        sum_l1 = jnp.sum(p2 * self.pad_mask, axis=1, keepdims=True)
+        # second-order terms
+        term2 = rho * (sum_l1 - p2) * self.pad_mask
+        p3 = term2 / one_minus_rho
+        sum_l2 = jnp.sum(p3 * self.pad_mask, axis=1, keepdims=True)
+        # third-order terms
+        term3 = rho * (sum_l2 - p3) * self.pad_mask
+
+        grad_pad = term1 + term2 + term3
         hess_pad = rho * (1.0 - rho) * self.pad_mask
+        # the reference zeroes single-doc queries (cnt <= 1)
+        multi = (jnp.sum(self.pad_mask, axis=1, keepdims=True) > 1.0)
+        grad_pad = jnp.where(multi, grad_pad, 0.0)
+        hess_pad = jnp.where(multi, hess_pad, 0.0)
         grad, hess = self._scatter_back(grad_pad, hess_pad)
         grad, hess = self._apply_weight(grad, hess)
         self._update_position_bias(grad, hess)

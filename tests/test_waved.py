@@ -34,9 +34,34 @@ def _train(X, y, wave_max, **extra):
     return lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=20)
 
 
-def test_waved_is_default():
+def test_waved_default_is_auto():
+    """tpu_wave_max=-1 (auto): waved for single-output objectives, exact
+    for multiclass (softmax calibration is split-order-sensitive; the
+    waved path at wave size 1 is bit-identical to exact, batching >= 2
+    drifts multiclass logloss — see config.py tpu_wave_max)."""
     from lightgbm_tpu.config import Config
-    assert Config().tpu_wave_max > 0
+    assert Config().tpu_wave_max == -1
+    X, y = make_binary(400)
+    bst = lgb.Booster({"objective": "binary", "num_leaves": 7,
+                       "verbosity": -1}, lgb.Dataset(X, label=y))
+    assert bst._gbdt._use_waved()
+    from tests.conftest import make_multiclass
+    Xm, ym = make_multiclass(400)
+    bstm = lgb.Booster({"objective": "multiclass", "num_class": 4,
+                        "num_leaves": 7, "verbosity": -1},
+                       lgb.Dataset(Xm, label=ym))
+    assert not bstm._gbdt._use_waved()
+    # explicit setting overrides auto in both directions
+    bstm2 = lgb.Booster({"objective": "multiclass", "num_class": 4,
+                         "num_leaves": 7, "verbosity": -1,
+                         "tpu_wave_max": 42}, lgb.Dataset(Xm, label=ym))
+    assert bstm2._gbdt._use_waved()
+    # OVA trains independent per-class binary trees (no softmax
+    # coupling), so auto keeps the waved default there
+    bsto = lgb.Booster({"objective": "multiclassova", "num_class": 4,
+                        "num_leaves": 7, "verbosity": -1},
+                       lgb.Dataset(Xm, label=ym))
+    assert bsto._gbdt._use_waved()
 
 
 def test_waved_quality_parity_binary():
