@@ -155,6 +155,11 @@ class BinnedDataset:
         # bundle_info maps logical features into them (ref:
         # dataset.cpp:251 FastFeatureBundling; see bundling.py)
         self.bundle_info = None
+        # sparse row-wise COO storage (ref: multi_val_sparse_bin.hpp:21):
+        # when set to (rows, feats, bins, zero_bins) int32 arrays,
+        # bins_fm is a [1, N] placeholder and histogram/partition paths
+        # run on the COO triples (ops.partition.SparseBins)
+        self.sparse_coo = None
 
     # ------------------------------------------------------------------
     @property
@@ -291,6 +296,13 @@ class BinnedDataset:
             mappers = reference.mappers
             used = reference.used_features
             nb = np.array([m.num_bins for m in mappers], np.int64)
+            if reference.sparse_coo is not None:
+                # mirror the COO storage layout
+                zb = reference.sparse_coo[3]
+                ds = cls._emit_coo(csc, mappers, used,
+                                   reference.num_total_features, metadata,
+                                   reference.feature_names, zb, n)
+                return ds
             if reference.bundle_info is not None:
                 bundles = [list(b) for b in reference.bundle_info.bundles]
             else:
@@ -376,6 +388,33 @@ class BinnedDataset:
         else:
             bundles = [[j] for j in range(len(mappers))]
 
+        # --- sparse row-wise COO mode (ref: bin.h:482 MultiValBin sparse
+        # variant): when bundling can't shrink the dense layout enough,
+        # O(nnz) segment-sum histograms beat the O(G*N*B) dense passes.
+        # Estimated from the sample's post-zero-bin-filter density.
+        est_nnz = sum(len(r) for r in nz_rows) * (n / max(sample_cnt, 1))
+        mode = getattr(config, "tpu_sparse_hist", "auto")
+        coo_eligible = (config.tree_learner in ("serial",)
+                        and not config.linear_tree and len(mappers) > 1)
+        if mode == "force" and not coo_eligible:
+            import warnings
+            warnings.warn(
+                "tpu_sparse_hist=force needs tree_learner=serial, "
+                "linear_tree=false and >1 used feature; using the "
+                "dense layout")
+        use_coo = (coo_eligible
+                   and (mode == "force"
+                        or (mode == "auto"
+                            # 48x compute-bias factor: a scatter-added
+                            # COO element costs far more than an MXU
+                            # one-hot lane; COO must be ~50x leaner
+                            and 48.0 * est_nnz < len(bundles) * n)))
+        if use_coo:
+            ds = cls._emit_coo(csc, mappers, used, f, metadata,
+                               feature_names,
+                               zero_bins.astype(np.int32), n)
+            return ds
+
         if len(bundles) == len(mappers):
             # nothing bundled: emit the plain [F, N] layout in FEATURE
             # order (find_bundles returns nnz-descending order) and skip
@@ -389,6 +428,32 @@ class BinnedDataset:
         # the sparse matrix itself serves as raw_data: prediction paths
         # densify in batches, continued training fast-forwards through
         # predict_raw (linear trees are rejected above)
+        ds.raw_data = csc.tocsr()
+        return ds
+
+    @classmethod
+    def _emit_coo(cls, csc, mappers, used, num_total_features, metadata,
+                  feature_names, zero_bins: np.ndarray,
+                  n: int) -> "BinnedDataset":
+        """Emit COO sparse storage: per used feature, bin the explicit
+        nonzeros and keep only entries off the implicit-zero bin (their
+        mass is recovered from leaf totals at histogram time)."""
+        rows_l, feats_l, bins_l = [], [], []
+        for j, col in enumerate(used):
+            sl = slice(csc.indptr[col], csc.indptr[col + 1])
+            fb = mappers[j].transform(
+                np.asarray(csc.data[sl], np.float64)).astype(np.int32)
+            keep = fb != zero_bins[j]
+            rows_l.append(csc.indices[sl][keep].astype(np.int32))
+            feats_l.append(np.full(int(keep.sum()), j, np.int32))
+            bins_l.append(fb[keep])
+        ds = cls(np.zeros((1, n), np.uint8), mappers, used,
+                 num_total_features, metadata, feature_names)
+        ds.sparse_coo = (
+            np.concatenate(rows_l) if rows_l else np.zeros(0, np.int32),
+            np.concatenate(feats_l) if feats_l else np.zeros(0, np.int32),
+            np.concatenate(bins_l) if bins_l else np.zeros(0, np.int32),
+            np.asarray(zero_bins, np.int32))
         ds.raw_data = csc.tocsr()
         return ds
 
@@ -434,12 +499,38 @@ class BinnedDataset:
     # ------------------------------------------------------------------
     def device_bins(self):
         """Bin matrix as a device array (cached). Bundled storage when
-        bundle_info is set — pair with device_bundle()."""
+        bundle_info is set — pair with device_bundle(). COO SparseBins
+        pytree when sparse_coo is set."""
         import jax.numpy as jnp
         key = "bins"
         if key not in self._device_cache:
-            self._device_cache[key] = jnp.asarray(self.bins_fm)
+            if self.sparse_coo is not None:
+                from .ops.partition import SparseBins
+                rows, feats, bins, zb = self.sparse_coo
+                self._device_cache[key] = SparseBins(
+                    jnp.asarray(rows), jnp.asarray(feats),
+                    jnp.asarray(bins), jnp.asarray(zb))
+            else:
+                self._device_cache[key] = jnp.asarray(self.bins_fm)
         return self._device_cache[key]
+
+    def host_feature_bins(self, j: int) -> np.ndarray:
+        """One logical feature's [N] bin column on host (dense slice, or
+        COO materialization for sparse storage). Bundled datasets decode
+        through bundle_info."""
+        if self.sparse_coo is not None:
+            rows, feats, bins, zb = self.sparse_coo
+            out = np.full(self.num_data, zb[j], np.int32)
+            sel = feats == j
+            out[rows[sel]] = bins[sel]
+            return out
+        if self.bundle_info is not None:
+            from .bundling import decode_stored_host
+            return decode_stored_host(
+                self.bins_fm[self.bundle_info.group_of[j]].astype(np.int32),
+                self.bundle_info.offset_of[j],
+                self.mappers[j].num_bins - 1)
+        return self.bins_fm[j].astype(np.int32)
 
     def device_bundle(self):
         """(group_of, offset_of, num_bins) device triple for EFB decode,

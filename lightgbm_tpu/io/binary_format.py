@@ -76,6 +76,14 @@ def save_dataset_binary(dataset, filename) -> None:
     arrays = {"bins_fm": binned.bins_fm,
               "header": np.frombuffer(
                   json.dumps(header).encode(), dtype=np.uint8)}
+    if binned.sparse_coo is not None:
+        # COO sparse storage: bins_fm is only a [1, N] placeholder, the
+        # real payload is the (rows, feats, bins, zero_bins) triples
+        rows, feats, bins, zb = binned.sparse_coo
+        arrays["sparse_rows"] = rows
+        arrays["sparse_feats"] = feats
+        arrays["sparse_bins"] = bins
+        arrays["sparse_zero_bins"] = zb
     for name in ("label", "weight", "init_score", "query_boundaries",
                  "positions"):
         value = getattr(meta, name)
@@ -111,6 +119,10 @@ def load_dataset_binary(filename):
                                                np.int32)
         if "meta_positions" in z:
             meta.positions = np.asarray(z["meta_positions"], np.int32)
+        sparse_arrays = {k: np.asarray(z[k], np.int32)
+                         for k in ("sparse_rows", "sparse_feats",
+                                   "sparse_bins", "sparse_zero_bins")
+                         if k in z}
 
     mappers = [_mapper_from_state(s) for s in header["mappers"]]
     binned = BinnedDataset(
@@ -123,6 +135,11 @@ def load_dataset_binary(filename):
         from ..bundling import BundleInfo
         binned.bundle_info = BundleInfo.from_bundles(
             header["bundles"], [m.num_bins for m in mappers])
+    if sparse_arrays:
+        binned.sparse_coo = (
+            sparse_arrays["sparse_rows"], sparse_arrays["sparse_feats"],
+            sparse_arrays["sparse_bins"],
+            sparse_arrays["sparse_zero_bins"])
 
     ds = Dataset.__new__(Dataset)
     ds.data = None

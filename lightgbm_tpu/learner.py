@@ -275,8 +275,14 @@ def grow_tree(bins_fm: jax.Array,
               bundle=None,
               num_bundle_bins: int = 0,
               mono_pairwise: bool = False,
-              shard_mesh=None):
+              shard_mesh=None,
+              sparse_shape=None):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
+
+    sparse_shape: static (num_features, num_data) when bins_fm is a
+    SparseBins COO pytree (ultra-sparse storage — see
+    partition.SparseBins); histogram builds then run O(nnz)
+    segment-sums instead of dense one-hot contractions.
 
     shard_mesh: a 1-D jax.sharding.Mesh with rows sharded over its axis.
     With hist_impl="pallas", histogram builds run per-shard inside
@@ -299,14 +305,22 @@ def grow_tree(bins_fm: jax.Array,
     interaction_groups: optional [G, F] bool array of allowed feature
     combinations (ref: config.h interaction_constraints).
     """
-    num_data = bins_fm.shape[1]
-    num_features = (bins_fm.shape[0] if bundle is None
-                    else bundle[0].shape[0])
+    if sparse_shape is not None:
+        num_features, num_data = sparse_shape
+    else:
+        num_data = bins_fm.shape[1]
+        num_features = (bins_fm.shape[0] if bundle is None
+                        else bundle[0].shape[0])
     L = num_leaves
     f32 = hist_dtype
 
     build_bins = max_bins if bundle is None else num_bundle_bins
-    if shard_mesh is not None and shard_mesh.size > 1 and \
+    if sparse_shape is not None:
+        assert bundle is None, "sparse COO storage is not bundled"
+        build = functools.partial(
+            hist_ops.build_histogram_sparse,
+            num_features=num_features, max_bins=max_bins, dtype=f32)
+    elif shard_mesh is not None and shard_mesh.size > 1 and \
             hist_impl == "pallas":
         raw_build = _sharded_pallas_build(
             shard_mesh, max_bins=build_bins, dtype=f32,
@@ -315,7 +329,9 @@ def grow_tree(bins_fm: jax.Array,
         raw_build = functools.partial(
             hist_ops.build_histogram, max_bins=build_bins, dtype=f32,
             row_chunk=row_chunk, impl=hist_impl, precision=hist_precision)
-    if bundle is None:
+    if sparse_shape is not None:
+        pass  # build already set
+    elif bundle is None:
         build = raw_build
     else:
         # EFB: build on the bundled [G, N] columns, expand to the logical
@@ -658,7 +674,8 @@ def grow_tree_waved(bins_fm: jax.Array,
                     bundle=None,
                     num_bundle_bins: int = 0,
                     mono_pairwise: bool = False,
-                    shard_mesh=None):
+                    shard_mesh=None,
+                    sparse_shape=None):
     """Leaf-wise growth with waved (batched) histogram construction.
 
     Identical split mathematics to `grow_tree`, but histogram builds are
@@ -690,9 +707,14 @@ def grow_tree_waved(bins_fm: jax.Array,
     assert forced is None, "waved growth does not support forced splits"
     from .ops.pallas_histogram import hist_multi, hist_pallas_multi_int8
 
-    num_data = bins_fm.shape[1]
-    num_features = (bins_fm.shape[0] if bundle is None
-                    else bundle[0].shape[0])
+    if sparse_shape is not None:
+        assert bundle is None and quant is None, \
+            "sparse COO storage composes with neither EFB nor int8 hist"
+        num_features, num_data = sparse_shape
+    else:
+        num_data = bins_fm.shape[1]
+        num_features = (bins_fm.shape[0] if bundle is None
+                        else bundle[0].shape[0])
     L = num_leaves
     f32 = hist_dtype
     SLOTS = 42  # 128 MXU columns // 3 channels
@@ -700,7 +722,14 @@ def grow_tree_waved(bins_fm: jax.Array,
 
     use_shard_hist = (shard_mesh is not None and shard_mesh.size > 1
                       and hist_impl == "pallas")
-    if quant is not None and hist_impl == "pallas":
+    if sparse_shape is not None:
+        def multi_raw(bins, ghT_, row_leaf, ids):
+            # O(nnz) segment-sum wave pass (the sparse row-wise
+            # MultiValBin analog, multi_val_sparse_bin.hpp:70)
+            return hist_ops.hist_multi_sparse(
+                bins, ghT_, row_leaf, ids, num_features=num_features,
+                max_bins=max_bins, num_slots=ids.shape[0])
+    elif quant is not None and hist_impl == "pallas":
         g_int, h_int, g_scale, h_scale = quant
         m8 = sample_mask.astype(jnp.int8)
         ghT_i8 = jnp.stack([g_int.astype(jnp.int8) * m8,
@@ -1033,13 +1062,15 @@ def grow_tree_waved(bins_fm: jax.Array,
     return tree_arrays, row_leaf
 
 
-def replay_tree(tree: TreeArrays, bins_fm: jax.Array,
-                meta: FeatureMeta, bundle=None) -> jax.Array:
+def replay_tree(tree: TreeArrays, bins_fm, meta: FeatureMeta, bundle=None,
+                num_data: Optional[int] = None) -> jax.Array:
     """Re-derive the row -> leaf map of a grown tree on another binned
     dataset (device). Replays the recorded splits in creation order — the
     device analog of updating a validation ScoreUpdater
-    (ref: score_updater.hpp:22, gbdt.cpp UpdateScore valid path)."""
-    num_data = bins_fm.shape[1]
+    (ref: score_updater.hpp:22, gbdt.cpp UpdateScore valid path).
+    num_data is required when bins_fm is a SparseBins COO pytree."""
+    if num_data is None:
+        num_data = bins_fm.shape[1]
     num_splits = tree.split_leaf.shape[0]
 
     def step(row_leaf, inputs):

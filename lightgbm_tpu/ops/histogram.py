@@ -104,6 +104,46 @@ def build_histogram(bins_fm: jax.Array, grad: jax.Array, hess: jax.Array,
     return _hist_all_features(bins_fm, gh, max_bins, dtype)
 
 
+def build_histogram_sparse(sb, grad: jax.Array, hess: jax.Array,
+                           mask: jax.Array, *, num_features: int,
+                           max_bins: int, dtype=jnp.float32) -> jax.Array:
+    """Single-leaf histogram from COO storage (ref: the sparse row-wise
+    MultiValBin ConstructHistogram, multi_val_sparse_bin.hpp:70): one
+    O(nnz) segment-sum over explicit entries, then the implicit-zero bin
+    of every feature receives (leaf totals - explicit sums). Work scales
+    with nnz instead of N*F*B — the scaling axis wide-sparse data needs.
+    """
+    gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1).astype(dtype)
+    flat = sb.coo_feat * max_bins + sb.coo_bin
+    hist = jax.ops.segment_sum(gh[sb.coo_row], flat,
+                               num_segments=num_features * max_bins)
+    hist = hist.reshape(num_features, max_bins, NUM_HIST_CHANNELS)
+    totals = jnp.sum(gh, axis=0)                     # [3] leaf totals
+    resid = totals[None, :] - jnp.sum(hist, axis=1)  # [F, 3]
+    return hist.at[jnp.arange(num_features), sb.zero_bins].add(resid)
+
+
+def hist_multi_sparse(sb, ghT: jax.Array, row_leaf: jax.Array,
+                      leaf_ids: jax.Array, *, num_features: int,
+                      max_bins: int, num_slots: int) -> jax.Array:
+    """Multi-leaf wave histogram from COO storage: rows route to their
+    leaf's slot (or a dropped overflow slot), one segment-sum covers all
+    slots' explicit entries, and each slot's implicit-zero mass is
+    recovered from its own totals. Returns [S, F, B, 3]."""
+    eq = row_leaf[:, None] == leaf_ids[None, :]       # [N, S]
+    slot = jnp.where(jnp.any(eq, axis=1),
+                     jnp.argmax(eq, axis=1), num_slots)
+    f, b, s = num_features, max_bins, num_slots
+    rs = slot[sb.coo_row]
+    flat = (rs * f + sb.coo_feat) * b + sb.coo_bin
+    hist = jax.ops.segment_sum(ghT[sb.coo_row], flat,
+                               num_segments=(s + 1) * f * b)
+    hist = hist[:s * f * b].reshape(s, f, b, NUM_HIST_CHANNELS)
+    slot_tot = jax.ops.segment_sum(ghT, slot, num_segments=s + 1)[:s]
+    resid = slot_tot[:, None, :] - jnp.sum(hist, axis=2)  # [S, F, 3]
+    return hist.at[:, jnp.arange(f), sb.zero_bins].add(resid)
+
+
 def subtract_histogram(parent: jax.Array, child: jax.Array) -> jax.Array:
     """Sibling histogram via subtraction (ref: serial_tree_learner.cpp:582,
     FeatureHistogram::Subtract). Hessians/counts clamped at 0 to absorb

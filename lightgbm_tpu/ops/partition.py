@@ -9,18 +9,57 @@ Rather than physically permuting row indices per leaf, we keep a full-length
 
 from __future__ import annotations
 
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 
 from .split import MISSING_NAN
 
 
-def feature_bins(bins_fm: jax.Array, feature: jax.Array,
-                 bundle=None) -> jax.Array:
+class SparseBins(NamedTuple):
+    """COO binned storage for ultra-sparse, non-bundleable data — the
+    TPU-native analog of the reference's sparse row-wise MultiValBin
+    (ref: include/LightGBM/bin.h:482, multi_val_sparse_bin.hpp:21).
+
+    Only entries whose bin differs from the feature's implicit-zero bin
+    are stored; histogram builds run one O(nnz) segment-sum instead of
+    the O(N*F*B) dense one-hot contraction, and the implicit-zero bin
+    mass is recovered per feature as (leaf totals - explicit bins) —
+    the same residual trick the reference's sparse bins use. Flows
+    through the growers in the `bins_fm` argument slot; every consumer
+    dispatches on isinstance.
+
+    coo_row/coo_feat/coo_bin: [nnz] int32; zero_bins: [F] int32
+    (the bin an implicit zero maps to, per feature).
+    """
+    coo_row: jax.Array
+    coo_feat: jax.Array
+    coo_bin: jax.Array
+    zero_bins: jax.Array
+
+
+def sparse_feature_bins(sb: SparseBins, feature: jax.Array,
+                        num_data: int) -> jax.Array:
+    """Materialize one logical [N] bin column from the COO storage:
+    rows absent from the column's explicit entries carry its
+    implicit-zero bin."""
+    sel = sb.coo_feat == feature
+    rows = jnp.where(sel, sb.coo_row, num_data)  # OOB rows are dropped
+    out = jnp.full((num_data,), sb.zero_bins[feature], jnp.int32)
+    return out.at[rows].set(jnp.where(sel, sb.coo_bin, 0).astype(jnp.int32),
+                            mode="drop")
+
+
+def feature_bins(bins_fm, feature: jax.Array, bundle=None,
+                 num_data: int = 0) -> jax.Array:
     """Logical [N] bin column of `feature` — a plain row slice for a
-    dense matrix, or an on-the-fly decode of the EFB-bundled matrix
+    dense matrix, an on-the-fly decode of the EFB-bundled matrix
     (bundle = (group_of, offset_of, num_bins) device arrays; ref:
-    feature_group.h bin_offsets_ decoding)."""
+    feature_group.h bin_offsets_ decoding), or a COO materialization
+    for SparseBins storage."""
+    if isinstance(bins_fm, SparseBins):
+        return sparse_feature_bins(bins_fm, feature, num_data)
     if bundle is None:
         return jnp.take(bins_fm, feature, axis=0).astype(jnp.int32)
     group_of, offset_of, nb = bundle
@@ -44,7 +83,8 @@ def apply_split(row_leaf: jax.Array, bins_fm: jax.Array,
     `cat_mask` ([B] bool — the device analog of the reference's category
     bitset, tree.h:375) go left. No-op when `valid` is False.
     """
-    fbins = feature_bins(bins_fm, feature, bundle)  # [N]
+    fbins = feature_bins(bins_fm, feature, bundle,
+                         num_data=row_leaf.shape[0])  # [N]
     nan_bin = num_bins[feature] - 1
     is_nan = (missing_type[feature] == MISSING_NAN) & (fbins == nan_bin)
     numerical = jnp.where(is_nan, default_left, fbins <= threshold)

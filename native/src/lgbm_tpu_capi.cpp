@@ -10,9 +10,18 @@
 // small registry integers cast to opaque pointers — the same contract as
 // the reference's DatasetHandle/BoosterHandle (c_api.h:28-34).
 //
-// The reference guards its Booster with shared/unique locks
-// (c_api.cpp:170); here the GIL serves the same role: every call takes
-// PyGILState_Ensure, so concurrent callers serialize safely.
+// Concurrency contract: the reference guards its Booster with
+// shared/unique locks (c_api.cpp:170), which lets concurrent predicts
+// proceed in parallel with each other (shared) while train iterations
+// take the unique lock. Here the GIL serves the lock role: every entry
+// point takes PyGILState_Ensure, so concurrent callers are SAFE but
+// FULLY SERIALIZED — including predict-during-train, which the
+// reference would run concurrently. The practical difference is small:
+// the heavy compute runs inside XLA executables that hold the GIL for
+// their (host-side) duration anyway, and TPU deployments get
+// parallelism from batching rather than caller threads. Multi-threaded
+// C consumers needing overlapped predict should batch rows per call or
+// run separate processes.
 
 #include <Python.h>
 
