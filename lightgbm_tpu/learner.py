@@ -863,10 +863,14 @@ def grow_tree_waved(bins_fm: jax.Array,
         dleft = leaves.default_left[best_leaf]
         cmask = leaves.cat_mask[best_leaf]
 
-        row_leaf = part_ops.apply_split(
-            row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft, cmask,
-            meta.num_bins, meta.missing_type, meta.is_categorical, valid,
-            bundle)
+        if sparse_shape is not None:
+            # COO storage: per-split column materialization (the batched
+            # wave partition below needs per-row feature gathers the COO
+            # layout can't serve)
+            row_leaf = part_ops.apply_split(
+                row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
+                cmask, meta.num_bins, meta.missing_type,
+                meta.is_categorical, valid, bundle)
 
         pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
                       leaves.count[best_leaf])
@@ -967,6 +971,20 @@ def grow_tree_waved(bins_fm: jax.Array,
         all_records.append(ys["record"])
         all_valid.append(ys["valid"])
         s0 += W
+
+        if sparse_shape is None:
+            # ONE batched partition pass for the whole wave (dense/EFB
+            # layouts; each row moves at most once per wave — see
+            # partition.apply_wave_splits). The COO path partitioned
+            # inside wave_step instead.
+            row_leaf = part_ops.apply_wave_splits(
+                row_leaf, bins_fm, ys["left_id"], ys["right_id"],
+                ys["record"]["split_feature"],
+                ys["record"]["split_bin_threshold"],
+                ys["record"]["split_default_left"],
+                ys["record"]["split_cat_mask"], ys["valid"],
+                meta.num_bins, meta.missing_type, meta.is_categorical,
+                L, bundle)
 
         if wi == len(schedule) - 1:
             # the tree is full: the children of the final wave can never

@@ -64,9 +64,66 @@ def feature_bins(bins_fm, feature: jax.Array, bundle=None,
         return jnp.take(bins_fm, feature, axis=0).astype(jnp.int32)
     group_of, offset_of, nb = bundle
     col = jnp.take(bins_fm, group_of[feature], axis=0).astype(jnp.int32)
-    off = offset_of[feature]
-    in_range = (col >= off) & (col < off + nb[feature] - 1)
+    return _decode_bundled(col, offset_of[feature], nb[feature])
+
+
+def _decode_bundled(col: jax.Array, off: jax.Array,
+                    nbf: jax.Array) -> jax.Array:
+    """EFB stored-column -> logical bin (ref: feature_group.h
+    bin_offsets_): values inside [off, off + nbf - 1) map to logical
+    bins 1.., everything else is the feature's implicit bin 0. Single
+    source of the decode rule for every device bin consumer."""
+    in_range = (col >= off) & (col < off + nbf - 1)
     return jnp.where(in_range, col - off + 1, 0)
+
+
+def _per_row_feature_bins(bins_fm: jax.Array, feat: jax.Array,
+                          bundle=None) -> jax.Array:
+    """bins of feature feat[i] for every row i — the gathered analog of
+    feature_bins for per-row feature indices (feat: [N] int32)."""
+    n = feat.shape[0]
+    rows = jnp.arange(n)
+    if bundle is None:
+        return bins_fm[feat, rows].astype(jnp.int32)
+    group_of, offset_of, nb = bundle
+    col = bins_fm[group_of[feat], rows].astype(jnp.int32)
+    return _decode_bundled(col, offset_of[feat], nb[feat])
+
+
+def apply_wave_splits(row_leaf: jax.Array, bins_fm: jax.Array,
+                      leaf_ids: jax.Array, right_ids: jax.Array,
+                      features: jax.Array, thresholds: jax.Array,
+                      default_lefts: jax.Array, cat_masks: jax.Array,
+                      valid: jax.Array, num_bins: jax.Array,
+                      missing_type: jax.Array, is_categorical: jax.Array,
+                      num_leaves: int, bundle=None) -> jax.Array:
+    """Apply a whole wave's W splits in ONE pass over the rows.
+
+    A wave's split leaves are pairwise distinct and a leaf created
+    within the wave is never split in the same wave (its candidates are
+    unknown until the boundary), so each row moves AT MOST once per
+    wave — the W sequential apply_split passes (each reading a bin row
+    + row_leaf, ~9 bytes/row/split of HBM traffic) collapse into one
+    gathered decision (~40 bytes/row/WAVE). This is the partition
+    analog of the multi-leaf histogram kernel and the main HBM saving
+    of waved growth beyond the histogram batching itself.
+    """
+    w_count = leaf_ids.shape[0]
+    L = num_leaves
+    lids = jnp.where(valid, leaf_ids, L)
+    table = jnp.full((L + 1,), -1, jnp.int32).at[lids].set(
+        jnp.arange(w_count, dtype=jnp.int32))
+    widx = table[row_leaf]
+    hit = widx >= 0
+    w = jnp.maximum(widx, 0)
+    feat = features[w]                              # [N]
+    fbins = _per_row_feature_bins(bins_fm, feat, bundle)
+    nan_bin = num_bins[feat] - 1
+    is_nan = (missing_type[feat] == MISSING_NAN) & (fbins == nan_bin)
+    go_num = jnp.where(is_nan, default_lefts[w], fbins <= thresholds[w])
+    go_left = jnp.where(is_categorical[feat], cat_masks[w, fbins], go_num)
+    move = hit & ~go_left
+    return jnp.where(move, right_ids[w], row_leaf)
 
 
 def apply_split(row_leaf: jax.Array, bins_fm: jax.Array,

@@ -227,3 +227,47 @@ def test_hist_pallas_single_matches_xla():
     pal = hist_pallas(bins, gh3, max_bins=b, interpret=True)
     np.testing.assert_allclose(np.asarray(pal), np.asarray(ref),
                                rtol=1e-6, atol=1e-6)
+
+
+def test_apply_wave_splits_matches_sequential():
+    """The batched wave partition must be BIT-equivalent to the
+    sequential apply_split chain it replaced (dense + EFB-bundled,
+    categorical, NaN default-left routing, invalid steps)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops import partition as part_ops
+
+    rng = np.random.RandomState(0)
+    N, F, B, L, W = 500, 6, 16, 15, 5
+    for trial in range(8):
+        bins = rng.randint(0, B, (F, N)).astype(np.uint8)
+        row_leaf = rng.randint(0, 8, N).astype(np.int32)
+        # distinct split leaves; last one invalid
+        leaves = rng.permutation(8)[:W].astype(np.int32)
+        rights = (8 + np.arange(W)).astype(np.int32)
+        feats = rng.randint(0, F, W).astype(np.int32)
+        thrs = rng.randint(0, B - 1, W).astype(np.int32)
+        dlefts = rng.rand(W) > 0.5
+        cmasks = rng.rand(W, B) > 0.5
+        valid = np.ones(W, bool)
+        valid[-1] = False
+        num_bins = np.full(F, B, np.int32)
+        missing = rng.randint(0, 3, F).astype(np.int32)
+        is_cat = rng.rand(F) > 0.7
+
+        seq = jnp.asarray(row_leaf)
+        for w in range(W):
+            seq = part_ops.apply_split(
+                seq, jnp.asarray(bins), jnp.int32(leaves[w]),
+                jnp.int32(rights[w]), jnp.int32(feats[w]),
+                jnp.int32(thrs[w]), jnp.bool_(dlefts[w]),
+                jnp.asarray(cmasks[w]), jnp.asarray(num_bins),
+                jnp.asarray(missing), jnp.asarray(is_cat),
+                jnp.bool_(valid[w]))
+        batched = part_ops.apply_wave_splits(
+            jnp.asarray(row_leaf), jnp.asarray(bins),
+            jnp.asarray(leaves), jnp.asarray(rights), jnp.asarray(feats),
+            jnp.asarray(thrs), jnp.asarray(dlefts), jnp.asarray(cmasks),
+            jnp.asarray(valid), jnp.asarray(num_bins),
+            jnp.asarray(missing), jnp.asarray(is_cat), L)
+        np.testing.assert_array_equal(np.asarray(seq),
+                                      np.asarray(batched))
