@@ -997,3 +997,100 @@ def booster_validate_feature_names(handle: int, names: list) -> None:
         raise ValueError(
             f"feature names mismatch: model has {model_names}, "
             f"data has {data_names}")
+
+
+# -- serialized dataset reference + ByteBuffer ------------------------------
+# (ref: LGBM_DatasetSerializeReferenceToBinary c_api.cpp +
+#  LGBM_DatasetCreateFromSerializedReference — ship the dataset SCHEMA
+#  (bin mappers, used features) to another process, which then fills a
+#  same-aligned dataset via the streaming push API; ByteBufferHandle is
+#  the transport, c_api.h:117)
+def dataset_serialize_reference(handle: int) -> int:
+    """Returns a ByteBuffer handle whose bytes encode the schema."""
+    import json as _json
+    from .io.binary_format import _mapper_state
+    ds = _resolve_ds(_get(handle)).construct()
+    binned = ds._binned
+    payload = {
+        "num_total_features": binned.num_total_features,
+        "used_features": [int(c) for c in binned.used_features],
+        "feature_names": list(binned.feature_names),
+        "mappers": [_mapper_state(m) for m in binned.mappers],
+    }
+    buf = _json.dumps(payload).encode("utf-8")
+    return _new_handle(buf)
+
+
+def byte_buffer_size(handle: int) -> int:
+    return len(_get(handle))
+
+
+def byte_buffer_get_at(handle: int, index: int) -> int:
+    return _get(handle)[index]
+
+
+def dataset_create_from_serialized_reference(buf_ptr: int, buf_size: int,
+                                             num_row: int,
+                                             num_classes: int,
+                                             parameters: str) -> int:
+    """(ref: LGBM_DatasetCreateFromSerializedReference c_api.cpp:1245)"""
+    import json as _json
+    from .dataset import BinnedDataset, Metadata
+    from .io.binary_format import _mapper_from_state
+    raw = ctypes.string_at(buf_ptr, buf_size)
+    payload = _json.loads(raw.decode("utf-8"))
+    mappers = [_mapper_from_state(s) for s in payload["mappers"]]
+    used = payload["used_features"]
+    ref_binned = BinnedDataset(
+        np.zeros((1, 0), np.uint8), mappers, used,
+        payload["num_total_features"], Metadata(0),
+        feature_names=payload["feature_names"])
+    from .io.binary_format import make_dataset_shell
+    ref = make_dataset_shell(ref_binned, _parse_params(parameters))
+    sd = _StreamingDataset(num_row, payload["num_total_features"],
+                           _parse_params(parameters), ref)
+    if num_classes > 1:
+        sd.nclasses = int(num_classes)
+    return _new_handle(sd)
+
+
+def booster_get_loaded_param(handle: int) -> str:
+    """(ref: LGBM_BoosterGetLoadedParam — JSON of the model's stored
+    parameters block)."""
+    import json as _json
+    bst = _get(handle)
+    params = dict(getattr(bst, "_loaded", None) and bst._loaded.params
+                  or bst.params or {})
+    return _json.dumps(params)
+
+
+# -- sparse (CSR) prediction output ----------------------------------------
+def booster_predict_sparse_output(handle: int, indptr_ptr: int,
+                                  indptr_type: int, indices_ptr: int,
+                                  data_ptr: int, data_type: int,
+                                  nindptr: int, nelem: int, num_col: int,
+                                  predict_type: int, start_iteration: int,
+                                  num_iteration: int) -> tuple:
+    """Feature contributions as CSR (ref: LGBM_BoosterPredictSparseOutput
+    c_api.cpp — contrib matrices are mostly zero on sparse input).
+    Returns (indptr_bytes, indices_bytes, data_bytes, out_nindptr,
+    out_nelem); the C side copies into malloc'd buffers the caller
+    frees with LGBM_BoosterFreePredictSparse."""
+    from scipy import sparse
+    if predict_type != _PREDICT_CONTRIB:
+        raise ValueError(
+            "sparse output is defined for contribution prediction")
+    csr = _csr_from_ptrs(indptr_ptr, indptr_type, indices_ptr, data_ptr,
+                         data_type, nindptr, nelem, num_col)
+    bst = _get(handle)
+    contrib = np.asarray(bst.predict(
+        csr, start_iteration=start_iteration, num_iteration=num_iteration,
+        pred_contrib=True), np.float64)
+    out = sparse.csr_matrix(contrib)
+    # outputs carry the CALLER's indptr/data element types, like the
+    # reference's allocation (FreePredictSparse takes both types)
+    indptr = np.ascontiguousarray(out.indptr, _NP_DTYPES[indptr_type])
+    indices = np.ascontiguousarray(out.indices, np.int32)
+    vals = np.ascontiguousarray(out.data, _NP_DTYPES[data_type])
+    return (indptr.tobytes(), indices.tobytes(), vals.tobytes(),
+            int(len(indptr)), int(len(vals)))

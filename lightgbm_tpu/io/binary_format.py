@@ -141,6 +141,16 @@ def load_dataset_binary(filename):
             sparse_arrays["sparse_bins"],
             sparse_arrays["sparse_zero_bins"])
 
+    return make_dataset_shell(binned, {})
+
+
+def make_dataset_shell(binned, params: dict):
+    """A basic.Dataset wrapper around an already-constructed
+    BinnedDataset (no raw data) — shared by the binary loader and the
+    C-ABI serialized-reference path so the shell attribute set has a
+    single source."""
+    from ..basic import Dataset
+    meta = binned.metadata
     ds = Dataset.__new__(Dataset)
     ds.data = None
     ds.label = meta.label
@@ -149,9 +159,9 @@ def load_dataset_binary(filename):
     ds.init_score = meta.init_score
     ds.position = meta.positions
     ds.reference = None
-    ds.feature_name = header["feature_names"]
+    ds.feature_name = list(binned.feature_names)
     ds.categorical_feature = "auto"
-    ds.params = {}
+    ds.params = dict(params)
     ds.free_raw_data = True
     ds._binned = binned
     ds.used_indices = None

@@ -1,5 +1,5 @@
 // C-ABI compatibility shim: a subset of the reference's `LGBM_*` surface
-// (ref: include/LightGBM/c_api.h, 131 functions; this shim covers 78
+// (ref: include/LightGBM/c_api.h, 131 functions; this shim covers 85
 // covering dataset/booster lifecycle, streaming push (ChunkedArray flow),
 // fast single-row predict configs, and model surgery — backed by the lightgbm_tpu Python framework
 // through an embedded CPython interpreter.
@@ -26,6 +26,7 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <mutex>
 #include <string>
@@ -1243,4 +1244,159 @@ LGBM_API int LGBM_NetworkFree() {
   EnsureInterpreter();
   Gil gil;
   return HandleResult(Call("network_free", "()"));
+}
+
+// -- serialized dataset reference + ByteBuffer (ref: c_api.h:117,545) ------
+
+typedef void* ByteBufferHandle;
+
+LGBM_API int LGBM_DatasetSerializeReferenceToBinary(DatasetHandle handle,
+                                                    ByteBufferHandle* out,
+                                                    int32_t* out_len) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_serialize_reference", "(L)",
+                     (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  long long buf = PyLong_AsLongLong(r);
+  Py_DECREF(r);
+  PyObject* sz = Call("byte_buffer_size", "(L)", buf);
+  if (sz == nullptr) return -1;
+  *out_len = (int32_t)PyLong_AsLong(sz);
+  Py_DECREF(sz);
+  *out = reinterpret_cast<ByteBufferHandle>((intptr_t)buf);
+  return 0;
+}
+
+LGBM_API int LGBM_ByteBufferGetAt(ByteBufferHandle handle, int32_t index,
+                                  uint8_t* out_val) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("byte_buffer_get_at", "(Li)",
+                     (long long)AsHandleInt(handle), (int)index);
+  if (r == nullptr) return -1;
+  *out_val = (uint8_t)PyLong_AsLong(r);
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_ByteBufferFree(ByteBufferHandle handle) {
+  EnsureInterpreter();
+  Gil gil;
+  return HandleResult(Call("handle_free", "(L)",
+                           (long long)AsHandleInt(handle)));
+}
+
+LGBM_API int LGBM_DatasetCreateFromSerializedReference(
+    const void* ref_buffer, int32_t ref_buffer_size, int64_t num_row,
+    int32_t num_classes, const char* parameters, DatasetHandle* out) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("dataset_create_from_serialized_reference", "(LiLis)",
+                     (long long)(intptr_t)ref_buffer, (int)ref_buffer_size,
+                     (long long)num_row, (int)num_classes,
+                     parameters ? parameters : "");
+  if (r == nullptr) return -1;
+  *out = reinterpret_cast<DatasetHandle>((intptr_t)PyLong_AsLongLong(r));
+  Py_DECREF(r);
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterGetLoadedParam(BoosterHandle handle,
+                                        int64_t buffer_len,
+                                        int64_t* out_len, char* out_str) {
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_get_loaded_param", "(L)",
+                     (long long)AsHandleInt(handle));
+  if (r == nullptr) return -1;
+  Py_ssize_t size = 0;
+  const char* s = PyUnicode_AsUTF8AndSize(r, &size);
+  if (s == nullptr) {
+    Py_DECREF(r);
+    g_last_error = "param dump encode failed";
+    return -1;
+  }
+  *out_len = (int64_t)size + 1;
+  if (buffer_len >= size + 1) {
+    std::memcpy(out_str, s, size + 1);
+  }
+  Py_DECREF(r);
+  return 0;
+}
+
+// -- sparse (CSR) prediction output (ref: c_api.h:1117) --------------------
+
+LGBM_API int LGBM_BoosterPredictSparseOutput(
+    BoosterHandle handle, const void* indptr, int indptr_type,
+    const int32_t* indices, const void* data, int data_type,
+    int64_t nindptr, int64_t nelem, int64_t num_col_or_row,
+    int predict_type, int start_iteration, int num_iteration,
+    const char* parameter, int matrix_type, int64_t* out_len,
+    void** out_indptr, int32_t** out_indices, void** out_data) {
+  (void)parameter;
+  if (matrix_type != 0 /* C_API_MATRIX_TYPE_CSR */) {
+    g_last_error = "only CSR matrix_type is supported";
+    return -1;
+  }
+  EnsureInterpreter();
+  Gil gil;
+  PyObject* r = Call("booster_predict_sparse_output", "(LLiLLiLLLiii)",
+                     (long long)AsHandleInt(handle),
+                     (long long)(intptr_t)indptr, indptr_type,
+                     (long long)(intptr_t)indices,
+                     (long long)(intptr_t)data, data_type,
+                     (long long)nindptr, (long long)nelem,
+                     (long long)num_col_or_row, predict_type,
+                     start_iteration, num_iteration);
+  if (r == nullptr) return -1;
+  PyObject *b_indptr = nullptr, *b_indices = nullptr, *b_data = nullptr;
+  int out_nindptr = 0;
+  long long out_nelem = 0;
+  if (!PyArg_ParseTuple(r, "SSSiL", &b_indptr, &b_indices, &b_data,
+                        &out_nindptr, &out_nelem)) {
+    PyErr_Clear();
+    Py_DECREF(r);
+    g_last_error = "bad tuple from booster_predict_sparse_output";
+    return -1;
+  }
+  // caller frees with LGBM_BoosterFreePredictSparse (plain free())
+  void* p_indptr = std::malloc(PyBytes_GET_SIZE(b_indptr));
+  int32_t* p_indices =
+      static_cast<int32_t*>(std::malloc(PyBytes_GET_SIZE(b_indices)));
+  void* p_data = std::malloc(PyBytes_GET_SIZE(b_data));
+  if (p_indptr == nullptr || p_indices == nullptr || p_data == nullptr) {
+    std::free(p_indptr);
+    std::free(p_indices);
+    std::free(p_data);
+    Py_DECREF(r);
+    g_last_error = "sparse output allocation failed";
+    return -1;
+  }
+  std::memcpy(p_indptr, PyBytes_AS_STRING(b_indptr),
+              PyBytes_GET_SIZE(b_indptr));
+  std::memcpy(p_indices, PyBytes_AS_STRING(b_indices),
+              PyBytes_GET_SIZE(b_indices));
+  std::memcpy(p_data, PyBytes_AS_STRING(b_data),
+              PyBytes_GET_SIZE(b_data));
+  Py_DECREF(r);
+  *out_indptr = p_indptr;
+  *out_indices = p_indices;
+  *out_data = p_data;
+  // the reference contract: out_len is a 2-entry array — [0] = element
+  // count (indices/data length), [1] = indptr length (c_api.h:1117)
+  out_len[0] = out_nelem;
+  out_len[1] = (int64_t)out_nindptr;
+  return 0;
+}
+
+LGBM_API int LGBM_BoosterFreePredictSparse(void* indptr, int32_t* indices,
+                                           void* data, int indptr_type,
+                                           int data_type) {
+  (void)indptr_type;
+  (void)data_type;
+  std::free(indptr);
+  std::free(indices);
+  std::free(data);
+  return 0;
 }

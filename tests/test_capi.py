@@ -572,3 +572,142 @@ class TestCApiExtendedSurface:
         assert b"num_iterations" in buf.value
         _check(lib, lib.LGBM_NetworkInit(b"127.0.0.1:12400", 12400, 120, 1))
         _check(lib, lib.LGBM_NetworkFree())
+
+
+class TestCApiSerializedReference:
+    """Schema shipping between processes (ref: test_stream.cpp:304 uses
+    a serialized reference + streaming push): serialize a dataset's
+    schema to a ByteBuffer, rebuild an aligned dataset from the bytes,
+    fill it with PushRows, train."""
+
+    def test_serialize_roundtrip_and_stream(self, lib):
+        X, y = make_binary(300, 5)
+        X64 = np.ascontiguousarray(X, np.float64)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X64.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(300),
+            ctypes.c_int32(5), 1, b"max_bin=31", None, ctypes.byref(ds)))
+        buf = ctypes.c_void_p()
+        blen = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetSerializeReferenceToBinary(
+            ds, ctypes.byref(buf), ctypes.byref(blen)))
+        assert blen.value > 50
+        # read the bytes out through ByteBufferGetAt
+        raw = bytearray(blen.value)
+        v = ctypes.c_uint8()
+        for i in range(blen.value):
+            _check(lib, lib.LGBM_ByteBufferGetAt(
+                buf, ctypes.c_int32(i), ctypes.byref(v)))
+            raw[i] = v.value
+        _check(lib, lib.LGBM_ByteBufferFree(buf))
+        assert raw.startswith(b"{")
+
+        # rebuild an aligned dataset from the serialized schema + stream
+        ds2 = ctypes.c_void_p()
+        cbuf = (ctypes.c_uint8 * blen.value).from_buffer(raw)
+        _check(lib, lib.LGBM_DatasetCreateFromSerializedReference(
+            cbuf, ctypes.c_int32(blen.value), ctypes.c_int64(300),
+            ctypes.c_int32(1), b"max_bin=31", ctypes.byref(ds2)))
+        _check(lib, lib.LGBM_DatasetPushRows(
+            ds2, X64.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(300), ctypes.c_int32(5), ctypes.c_int32(0)))
+        y32 = np.ascontiguousarray(y, np.float32)
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds2, b"label", y32.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(300), 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds2, b"objective=binary num_leaves=7 verbosity=-1",
+            ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+        _check(lib, lib.LGBM_BoosterFree(bst))
+        _check(lib, lib.LGBM_DatasetFree(ds))
+        _check(lib, lib.LGBM_DatasetFree(ds2))
+
+    def test_sparse_contrib_output(self, lib):
+        from scipy import sparse
+        rng = np.random.RandomState(2)
+        X = rng.randn(200, 6)
+        X[rng.rand(200, 6) < 0.5] = 0.0
+        y = (X[:, 0] > 0).astype(np.float32)
+        X64 = np.ascontiguousarray(X, np.float64)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X64.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(200),
+            ctypes.c_int32(6), 1, b"max_bin=31", None, ctypes.byref(ds)))
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(200), 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=7 verbosity=-1",
+            ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(4):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst,
+                                                      ctypes.byref(fin)))
+        csr = sparse.csr_matrix(X64)
+        indptr = np.ascontiguousarray(csr.indptr, np.int32)
+        indices = np.ascontiguousarray(csr.indices, np.int32)
+        vals = np.ascontiguousarray(csr.data, np.float64)
+        out_len = (ctypes.c_int64 * 2)()  # [nelem, nindptr] (c_api.h:1117)
+        o_indptr = ctypes.c_void_p()
+        o_indices = ctypes.POINTER(ctypes.c_int32)()
+        o_data = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterPredictSparseOutput(
+            bst, indptr.ctypes.data_as(ctypes.c_void_p), 2,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int64(len(indptr)), ctypes.c_int64(len(vals)),
+            ctypes.c_int64(6), 3, 0, -1, b"", 0,
+            out_len, ctypes.byref(o_indptr),
+            ctypes.byref(o_indices), ctypes.byref(o_data)))
+        nelem = out_len[0]
+        assert nelem > 0
+        assert out_len[1] == 201  # nrow + 1
+        got_indptr = np.ctypeslib.as_array(
+            ctypes.cast(o_indptr, ctypes.POINTER(ctypes.c_int32)),
+            shape=(int(out_len[1]),)).copy()
+        got_data = np.ctypeslib.as_array(
+            ctypes.cast(o_data, ctypes.POINTER(ctypes.c_double)),
+            shape=(nelem,)).copy()
+        # row sums of contributions equal raw predictions
+        row_sums = np.add.reduceat(
+            got_data, got_indptr[:-1][got_indptr[:-1] < nelem])
+        out = (ctypes.c_double * 200)()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, X64.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(200), ctypes.c_int32(6), 1, 1, 0, -1, b"",
+            ctypes.byref(out_len), out))
+        np.testing.assert_allclose(row_sums[:5], np.asarray(out[:5]),
+                                   rtol=1e-6, atol=1e-8)
+        _check(lib, lib.LGBM_BoosterFreePredictSparse(
+            o_indptr, o_indices, o_data, 2, 1))
+        _check(lib, lib.LGBM_BoosterFree(bst))
+        _check(lib, lib.LGBM_DatasetFree(ds))
+
+    def test_loaded_param(self, lib):
+        X, y = make_binary(200, 4)
+        X64 = np.ascontiguousarray(X, np.float64)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X64.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(200),
+            ctypes.c_int32(4), 1, b"max_bin=31", None, ctypes.byref(ds)))
+        y32 = np.ascontiguousarray(y, np.float32)
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y32.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(200), 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=7 verbosity=-1",
+            ctypes.byref(bst)))
+        buf = ctypes.create_string_buffer(1 << 16)
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterGetLoadedParam(
+            bst, ctypes.c_int64(1 << 16), ctypes.byref(out_len), buf))
+        import json
+        params = json.loads(buf.value.decode())
+        assert params.get("objective") == "binary"
+        _check(lib, lib.LGBM_BoosterFree(bst))
+        _check(lib, lib.LGBM_DatasetFree(ds))
