@@ -1086,6 +1086,14 @@ def booster_predict_sparse_output(handle: int, indptr_ptr: int,
     contrib = np.asarray(bst.predict(
         csr, start_iteration=start_iteration, num_iteration=num_iteration,
         pred_contrib=True), np.float64)
+    k = booster_num_model_per_iteration(handle)
+    if k > 1:
+        # reference layout: num_class * num_data rows x (num_feature + 1)
+        # cols, class-major like its other multi-output surfaces
+        # (c_api.h:1092)
+        n = contrib.shape[0]
+        contrib = contrib.reshape(n, k, -1).transpose(1, 0, 2).reshape(
+            n * k, -1)
     out = sparse.csr_matrix(contrib)
     # outputs carry the CALLER's indptr/data element types, like the
     # reference's allocation (FreePredictSparse takes both types)
@@ -1094,3 +1102,236 @@ def booster_predict_sparse_output(handle: int, indptr_ptr: int,
     vals = np.ascontiguousarray(out.data, _NP_DTYPES[data_type])
     return (indptr.tobytes(), indices.tobytes(), vals.tobytes(),
             int(len(indptr)), int(len(vals)))
+
+
+# -- Arrow C-data entry points (raw struct pointers) ------------------------
+# The PyCapsule-protocol ingestion in io/arrow_ingest.py does all the
+# work; these shims wrap the C API's raw ArrowArray/ArrowSchema/
+# ArrowArrayStream pointers in no-destructor capsules so the same
+# (dependency-free) reader consumes them (ref: c_api.cpp
+# LGBM_DatasetCreateFromArrow* family via nanoarrow).
+_PyCapsule_New = ctypes.pythonapi.PyCapsule_New
+_PyCapsule_New.restype = ctypes.py_object
+_PyCapsule_New.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                           ctypes.c_void_p]
+
+
+class _RawArrowArray:
+    def __init__(self, schema_ptr: int, array_ptr: int):
+        self._schema_ptr = schema_ptr
+        self._array_ptr = array_ptr
+
+    def __arrow_c_array__(self, requested_schema=None):
+        return (_PyCapsule_New(self._schema_ptr, b"arrow_schema", None),
+                _PyCapsule_New(self._array_ptr, b"arrow_array", None))
+
+
+class _RawArrowStream:
+    def __init__(self, stream_ptr: int):
+        self._stream_ptr = stream_ptr
+
+    def __arrow_c_stream__(self, requested_schema=None):
+        return _PyCapsule_New(self._stream_ptr, b"arrow_array_stream",
+                              None)
+
+
+def _arrow_chunks_matrix(n_chunks: int, chunks_ptr: int, schema_ptr: int):
+    from .io.arrow_ingest import ArrowArray, arrow_to_matrix
+    if n_chunks <= 0 or not chunks_ptr or not schema_ptr:
+        raise ValueError("empty Arrow chunked array")
+    sz = ctypes.sizeof(ArrowArray)
+    mats, names = [], None
+    for i in range(int(n_chunks)):
+        m, names = arrow_to_matrix(
+            _RawArrowArray(schema_ptr, chunks_ptr + i * sz))
+        mats.append(m)
+    return (np.concatenate(mats, axis=0) if len(mats) > 1 else mats[0],
+            names)
+
+
+def dataset_create_from_arrow(n_chunks: int, chunks_ptr: int,
+                              schema_ptr: int, parameters: str,
+                              reference: int) -> int:
+    mat, names = _arrow_chunks_matrix(n_chunks, chunks_ptr, schema_ptr)
+    ref = _resolve_ds(_get(reference)) if reference else None
+    ds = Dataset(np.asarray(mat, np.float64), reference=ref,
+                 feature_name=names or "auto",
+                 params=_parse_params(parameters))
+    return _new_handle(ds)
+
+
+def dataset_create_from_arrow_stream(stream_ptr: int, parameters: str,
+                                     reference: int) -> int:
+    from .io.arrow_ingest import arrow_to_matrix
+    mat, names = arrow_to_matrix(_RawArrowStream(stream_ptr))
+    ref = _resolve_ds(_get(reference)) if reference else None
+    ds = Dataset(np.asarray(mat, np.float64), reference=ref,
+                 feature_name=names or "auto",
+                 params=_parse_params(parameters))
+    return _new_handle(ds)
+
+
+def _set_field_values(handle: int, field: str, values: np.ndarray) -> None:
+    ds = _resolve_ds(_get(handle))
+    if field == "label":
+        ds.set_label(values)
+    elif field == "weight":
+        ds.set_weight(values)
+    elif field in ("group", "query"):
+        ds.set_group(values)
+    elif field == "init_score":
+        ds.set_init_score(values)
+    else:
+        raise ValueError(f"unknown field {field}")
+
+
+def dataset_set_field_from_arrow(handle: int, field: str, n_chunks: int,
+                                 chunks_ptr: int, schema_ptr: int) -> None:
+    from .io.arrow_ingest import ArrowArray, arrow_to_vector
+    if n_chunks <= 0 or not chunks_ptr or not schema_ptr:
+        raise ValueError("empty Arrow chunked array")
+    sz = ctypes.sizeof(ArrowArray)
+    parts = [arrow_to_vector(_RawArrowArray(schema_ptr,
+                                            chunks_ptr + i * sz))
+             for i in range(int(n_chunks))]
+    _set_field_values(handle, field,
+                      np.concatenate(parts) if len(parts) > 1 else parts[0])
+
+
+def dataset_set_field_from_arrow_stream(handle: int, field: str,
+                                        stream_ptr: int) -> None:
+    from .io.arrow_ingest import _iter_stream, _primitive_to_numpy
+    parts = []
+    for schema, array, _keep in _iter_stream(_RawArrowStream(stream_ptr)):
+        parts.append(_primitive_to_numpy(schema, array))
+    if not parts:
+        raise ValueError("empty Arrow stream")
+    _set_field_values(handle, field,
+                      np.concatenate(parts) if len(parts) > 1 else parts[0])
+
+
+def booster_predict_for_arrow(handle: int, n_chunks: int, chunks_ptr: int,
+                              schema_ptr: int, predict_type: int,
+                              start_iteration: int, num_iteration: int,
+                              out_ptr: int) -> int:
+    mat, _names = _arrow_chunks_matrix(n_chunks, chunks_ptr, schema_ptr)
+    return _predict_into(_get(handle), np.asarray(mat, np.float64),
+                         predict_type, start_iteration, num_iteration,
+                         out_ptr)
+
+
+def booster_predict_for_arrow_stream(handle: int, stream_ptr: int,
+                                     predict_type: int,
+                                     start_iteration: int,
+                                     num_iteration: int,
+                                     out_ptr: int) -> int:
+    from .io.arrow_ingest import arrow_to_matrix
+    mat, _names = arrow_to_matrix(_RawArrowStream(stream_ptr))
+    return _predict_into(_get(handle), np.asarray(mat, np.float64),
+                         predict_type, start_iteration, num_iteration,
+                         out_ptr)
+
+
+# -- CSC / multi-matrix creation -------------------------------------------
+def _csc_from_ptrs(col_ptr: int, col_ptr_type: int, indices_ptr: int,
+                   data_ptr: int, data_type: int, ncol_ptr: int,
+                   nelem: int, num_row: int):
+    from scipy import sparse
+    colptr = _array_from_ptr(col_ptr, ncol_ptr, col_ptr_type)
+    indices = _array_from_ptr(indices_ptr, nelem, 2)
+    data = _array_from_ptr(data_ptr, nelem, data_type)
+    return sparse.csc_matrix(
+        (np.asarray(data, np.float64), indices, colptr),
+        shape=(num_row, ncol_ptr - 1))
+
+
+def dataset_create_from_csc(col_ptr: int, col_ptr_type: int,
+                            indices_ptr: int, data_ptr: int,
+                            data_type: int, ncol_ptr: int, nelem: int,
+                            num_row: int, parameters: str,
+                            reference: int) -> int:
+    """(ref: LGBM_DatasetCreateFromCSC c_api.cpp — the col-wise twin)"""
+    csc = _csc_from_ptrs(col_ptr, col_ptr_type, indices_ptr, data_ptr,
+                         data_type, ncol_ptr, nelem, num_row)
+    ref = _resolve_ds(_get(reference)) if reference else None
+    ds = Dataset(csc, reference=ref, params=_parse_params(parameters))
+    return _new_handle(ds)
+
+
+def booster_predict_for_csc(handle: int, col_ptr: int, col_ptr_type: int,
+                            indices_ptr: int, data_ptr: int,
+                            data_type: int, ncol_ptr: int, nelem: int,
+                            num_row: int, predict_type: int,
+                            start_iteration: int, num_iteration: int,
+                            out_ptr: int) -> int:
+    csc = _csc_from_ptrs(col_ptr, col_ptr_type, indices_ptr, data_ptr,
+                         data_type, ncol_ptr, nelem, num_row)
+    return _predict_into(_get(handle), csc.tocsr(), predict_type,
+                         start_iteration, num_iteration, out_ptr)
+
+
+def dataset_create_from_mats(nmat: int, data_ptrs_ptr: int, data_type: int,
+                             nrow_ptr: int, ncol: int,
+                             is_row_major_ptr: int, parameters: str,
+                             reference: int) -> int:
+    """(ref: LGBM_DatasetCreateFromMats — stacked sub-matrices)"""
+    ptrs = _array_from_ptr(data_ptrs_ptr, nmat, 3)
+    nrows = _array_from_ptr(nrow_ptr, nmat, 2)
+    majors = _array_from_ptr(is_row_major_ptr, nmat, 2)
+    mats = []
+    for i in range(nmat):
+        n = int(nrows[i])
+        flat = _array_from_ptr(int(ptrs[i]), n * ncol, data_type)
+        mats.append(flat.reshape(n, ncol) if majors[i]
+                    else flat.reshape(ncol, n).T)
+    mat = np.concatenate(mats, axis=0) if len(mats) > 1 else mats[0]
+    ref = _resolve_ds(_get(reference)) if reference else None
+    ds = Dataset(np.asarray(mat, np.float64), reference=ref,
+                 params=_parse_params(parameters))
+    return _new_handle(ds)
+
+
+def _as_dense(ds) -> np.ndarray:
+    data = ds.get_data()
+    if hasattr(data, "todense"):
+        return np.asarray(data.todense(), np.float64)
+    return np.asarray(data, np.float64)
+
+
+def dataset_add_features_from(target: int, source: int) -> None:
+    """(ref: LGBM_DatasetAddFeaturesFrom dataset.cpp:1437 — append the
+    source dataset's features to the target). Requires raw data on both
+    (re-bins the combined matrix; the reference splices bin mappers).
+    The target's metadata (label/weight/group/init_score/position) and
+    both sides' feature names are preserved."""
+    tgt = _resolve_ds(_get(target))
+    src = _resolve_ds(_get(source))
+    if tgt.data is None or src.data is None:
+        raise ValueError("AddFeaturesFrom requires raw data on both "
+                         "datasets")
+    names = None
+    tn, sn = tgt.get_feature_name(), src.get_feature_name()
+    if tn and sn:
+        names = list(tn) + list(sn)
+    merged = Dataset(np.hstack([_as_dense(tgt), _as_dense(src)]),
+                     label=tgt.get_label(), weight=tgt.get_weight(),
+                     group=tgt.get_group(),
+                     init_score=tgt.get_init_score(),
+                     feature_name=names or "auto",
+                     params=dict(tgt.params or {}))
+    merged.position = getattr(tgt, "position", None)
+    merged.construct()
+    _registry[target] = merged
+
+
+def network_init_with_functions(num_machines: int, rank: int,
+                                reduce_scatter_ptr: int,
+                                allgather_ptr: int) -> None:
+    """API-parity seam for LGBM_NetworkInitWithFunctions
+    (c_api.cpp:2867): external collective callbacks are recorded but
+    collectives ride XLA over the jax mesh (see network_init)."""
+    _network_conf[0] = {"machines": "<external-functions>",
+                        "num_machines": int(num_machines),
+                        "rank": int(rank),
+                        "reduce_scatter_ext": int(reduce_scatter_ptr),
+                        "allgather_ext": int(allgather_ptr)}

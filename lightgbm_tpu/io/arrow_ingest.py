@@ -212,6 +212,10 @@ def _iter_stream(obj):
     finally:
         if schema.release:
             release_t(schema.release)(ctypes.byref(schema))
+        # the consumer owns the stream (Arrow C stream spec): release it
+        # after draining so producers can free private_data
+        if stream.release:
+            release_t(stream.release)(ptr)
 
 
 def is_arrow(obj) -> bool:

