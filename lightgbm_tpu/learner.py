@@ -675,8 +675,15 @@ def grow_tree_waved(bins_fm: jax.Array,
                     num_bundle_bins: int = 0,
                     mono_pairwise: bool = False,
                     shard_mesh=None,
-                    sparse_shape=None):
+                    sparse_shape=None,
+                    batched_partition=None):
     """Leaf-wise growth with waved (batched) histogram construction.
+
+    batched_partition: apply each wave's splits in one gathered pass
+    (partition.apply_wave_splits) instead of per-split passes. None =
+    auto: on for accelerator backends (the gather is an HBM-bandwidth
+    win), off on CPU (the gather loses to sequential masked passes) and
+    always off for COO sparse storage.
 
     Identical split mathematics to `grow_tree`, but histogram builds are
     batched: splits are applied in waves; at each wave boundary ONE
@@ -863,10 +870,12 @@ def grow_tree_waved(bins_fm: jax.Array,
         dleft = leaves.default_left[best_leaf]
         cmask = leaves.cat_mask[best_leaf]
 
-        if sparse_shape is not None:
-            # COO storage: per-split column materialization (the batched
-            # wave partition below needs per-row feature gathers the COO
-            # layout can't serve)
+        if not use_batched_partition:
+            # per-split partition: COO storage can't serve the batched
+            # pass's per-row feature gathers, and on CPU the gather is
+            # slower than W sequential masked passes (measured: bench
+            # fallback 3.6 -> 2.8 s/iter) — the batched pass is an HBM
+            # bandwidth optimization for accelerator backends
             row_leaf = part_ops.apply_split(
                 row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
                 cmask, meta.num_bins, meta.missing_type,
@@ -952,6 +961,10 @@ def grow_tree_waved(bins_fm: jax.Array,
             leaves.min_bound[cid], leaves.max_bound[cid],
             leaves.depth[cid] - 1, has_categorical, rb)
 
+    if batched_partition is None:
+        batched_partition = not hist_ops.cpu_backend()
+    use_batched_partition = sparse_shape is None and batched_partition
+
     all_records = []
     all_valid = []
     s0 = 0
@@ -972,11 +985,11 @@ def grow_tree_waved(bins_fm: jax.Array,
         all_valid.append(ys["valid"])
         s0 += W
 
-        if sparse_shape is None:
+        if use_batched_partition:
             # ONE batched partition pass for the whole wave (dense/EFB
-            # layouts; each row moves at most once per wave — see
-            # partition.apply_wave_splits). The COO path partitioned
-            # inside wave_step instead.
+            # layouts on accelerator backends; each row moves at most
+            # once per wave — see partition.apply_wave_splits). The COO
+            # and CPU paths partitioned inside wave_step instead.
             row_leaf = part_ops.apply_wave_splits(
                 row_leaf, bins_fm, ys["left_id"], ys["right_id"],
                 ys["record"]["split_feature"],

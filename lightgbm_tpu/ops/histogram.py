@@ -39,12 +39,18 @@ def _hist_all_features(bins_fm: jax.Array, gh: jax.Array, max_bins: int,
     return hist
 
 
+def cpu_backend() -> bool:
+    """True when the default jax backend is CPU (or undeterminable) —
+    the shared sniff for backend-dependent implementation choices."""
+    try:
+        return jax.default_backend() == "cpu"
+    except Exception:
+        return True
+
+
 def default_impl() -> str:
     """'pallas' on TPU backends, 'xla' elsewhere (CPU tests, interpret)."""
-    try:
-        return "xla" if jax.default_backend() == "cpu" else "pallas"
-    except Exception:
-        return "xla"
+    return "xla" if cpu_backend() else "pallas"
 
 
 def resolve_impl(cfg_impl: str) -> str:

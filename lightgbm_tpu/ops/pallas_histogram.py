@@ -44,10 +44,8 @@ def _resolve_interpret(interpret) -> bool:
     their shard_map mesh wrappers without a chip), Mosaic on TPU."""
     if interpret is not None:
         return interpret
-    try:
-        return jax.default_backend() == "cpu"
-    except Exception:
-        return True
+    from .histogram import cpu_backend
+    return cpu_backend()
 
 
 def _hist_kernel(bins_ref, gh_ref, out_ref, *, f_blk: int, max_bins: int,

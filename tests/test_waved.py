@@ -271,3 +271,48 @@ def test_apply_wave_splits_matches_sequential():
             jnp.asarray(missing), jnp.asarray(is_cat), L)
         np.testing.assert_array_equal(np.asarray(seq),
                                       np.asarray(batched))
+
+
+def test_batched_partition_through_grower_with_bundle():
+    """Force the batched wave partition (the TPU default) through the
+    FULL waved grower on CPU, on EFB-bundled one-hot data, and require
+    agreement with the per-split partition (the CPU default) — covers
+    the call-site wiring and the bundle-decode path of
+    partition._per_row_feature_bins end-to-end."""
+    import functools
+    import jax.numpy as jnp
+    from lightgbm_tpu import Dataset
+    from lightgbm_tpu.learner import grow_tree_waved
+
+    rng = np.random.RandomState(9)
+    n = 1500
+    # one-hot-ish mutually exclusive features so EFB actually bundles
+    hot = rng.randint(0, 6, n)
+    X = np.zeros((n, 6))
+    X[np.arange(n), hot] = rng.rand(n) * 3 + 0.5
+    y = np.isin(hot, [1, 4]).astype(np.float32)
+    ds = Dataset(X, label=y, params={"max_bin": 15,
+                                     "verbosity": -1}).construct()
+    binned = ds._binned
+    assert binned.bundle_info is not None, "EFB must engage for this test"
+    from lightgbm_tpu.basic import Booster
+    bst = Booster({"objective": "binary", "num_leaves": 15,
+                   "min_data_in_leaf": 5, "verbosity": -1}, ds)
+    g = bst._gbdt
+    grad = jnp.asarray(y - 0.5, jnp.float32)
+    hess = jnp.full(n, 0.25, jnp.float32)
+    mask = jnp.ones(n, jnp.float32)
+    fmask = jnp.ones(binned.num_features, bool)
+    kw = dict(g._grow_kwargs(), hist_dtype=jnp.float32, hist_impl="xla",
+              hist_precision="highest",
+              has_categorical=g._has_categorical)
+    outs = {}
+    for batched in (False, True):
+        rec, row_leaf = grow_tree_waved(
+            g.bins_fm, grad, hess, mask, fmask, g.feature_meta, g.hp,
+            g.max_depth, None, None, batched_partition=batched, **kw)
+        outs[batched] = (np.asarray(row_leaf), np.asarray(rec.leaf_count),
+                        np.asarray(rec.split_feature))
+    np.testing.assert_array_equal(outs[False][0], outs[True][0])
+    np.testing.assert_array_equal(outs[False][1], outs[True][1])
+    np.testing.assert_array_equal(outs[False][2], outs[True][2])
