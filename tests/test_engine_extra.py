@@ -129,3 +129,95 @@ class TestMulticlassPredictShape:
         np.testing.assert_allclose(proba.sum(1), 1.0, rtol=1e-5)
         raw = bst.predict(X, raw_score=True)
         assert raw.shape == (600, 4)
+
+
+def test_is_unbalance_shifts_probabilities():
+    """is_unbalance upweights the minority class (ref:
+    binary_objective.hpp label weight setup): predicted probabilities
+    on an imbalanced set shift up vs the plain objective."""
+    r = np.random.RandomState(0)
+    n = 2000
+    X = r.randn(n, 5)
+    y = ((X[:, 0] + 0.5 * r.randn(n)) > 1.1).astype(np.float32)  # ~13% pos
+    assert 0.05 < y.mean() < 0.25
+    p_plain = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 7},
+                        lgb.Dataset(X, label=y),
+                        num_boost_round=10).predict(X)
+    p_unbal = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 7, "is_unbalance": True},
+                        lgb.Dataset(X, label=y),
+                        num_boost_round=10).predict(X)
+    assert p_unbal.mean() > p_plain.mean() + 0.05
+
+
+def test_scale_pos_weight_shifts_probabilities():
+    r = np.random.RandomState(1)
+    n = 2000
+    X = r.randn(n, 5)
+    y = ((X[:, 0] + 0.5 * r.randn(n)) > 1.1).astype(np.float32)
+    p1 = lgb.train({"objective": "binary", "verbosity": -1,
+                    "num_leaves": 7},
+                   lgb.Dataset(X, label=y), num_boost_round=10).predict(X)
+    p5 = lgb.train({"objective": "binary", "verbosity": -1,
+                    "num_leaves": 7, "scale_pos_weight": 5.0},
+                   lgb.Dataset(X, label=y), num_boost_round=10).predict(X)
+    assert p5.mean() > p1.mean() + 0.05
+
+
+def test_first_metric_only_early_stopping():
+    """With first_metric_only, a deteriorating SECOND metric must not
+    stop training while the first keeps improving (ref: python-package
+    early_stopping(first_metric_only=True)). A custom feval that gets
+    strictly worse every round makes the discrimination deterministic:
+    without the flag it stops after stopping_rounds; with it, training
+    runs on the (improving) first metric."""
+    r = np.random.RandomState(2)
+    X = r.randn(1200, 5)
+    y = (X[:, 0] + 0.3 * r.randn(1200) > 0).astype(np.float32)
+    Xv, yv = X[800:], y[800:]
+    Xt, yt = X[:800], y[:800]
+
+    def make_worsening():
+        state = {"v": 0.0}
+
+        def worsening(_preds, _dataset):
+            state["v"] += 1.0
+            return "worsening", state["v"], False  # lower is better
+
+        return worsening
+
+    common = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
+              "metric": "binary_logloss", "early_stopping_round": 3}
+    rounds = 15
+    b_all = lgb.train(dict(common), lgb.Dataset(Xt, label=yt),
+                      num_boost_round=rounds,
+                      valid_sets=[lgb.Dataset(Xv, label=yv)],
+                      feval=make_worsening())
+    # the always-worsening metric must have stopped this run early
+    assert b_all.current_iteration() < rounds
+    b_first = lgb.train({**common, "first_metric_only": True},
+                        lgb.Dataset(Xt, label=yt), num_boost_round=rounds,
+                        valid_sets=[lgb.Dataset(Xv, label=yv)],
+                        feval=make_worsening())
+    # with first_metric_only the worsening metric is ignored
+    assert b_first.current_iteration() > b_all.current_iteration()
+
+
+def test_forcedbins_filename(tmp_path):
+    """forcedbins_filename pins bin upper bounds for chosen features
+    (ref: Dataset forced bins JSON, dataset_loader.cpp)."""
+    import json
+    r = np.random.RandomState(3)
+    X = r.rand(800, 3) * 10
+    y = (X[:, 0] > 5).astype(np.float32)
+    fb = tmp_path / "forced.json"
+    fb.write_text(json.dumps(
+        [{"feature": 0, "bin_upper_bound": [2.5, 5.0, 7.5]}]))
+    ds = lgb.Dataset(X, label=y, params={
+        "forcedbins_filename": str(fb), "max_bin": 15,
+        "verbosity": -1}).construct()
+    m = ds._binned.mappers[0]
+    ubs = np.asarray(m.bin_upper_bound, np.float64)
+    for b in (2.5, 5.0, 7.5):
+        assert np.any(np.isclose(ubs, b)), (b, ubs)
