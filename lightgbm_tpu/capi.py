@@ -926,15 +926,16 @@ _max_threads = [-1]
 
 def set_max_threads(n: int) -> None:
     """(ref: LGBM_SetMaxThreads — bounds the native thread pool; XLA
-    device parallelism is unaffected, like the reference's CUDA path)."""
-    _max_threads[0] = int(n)
+    device parallelism is unaffected, like the reference's CUDA path).
+    Any negative value resets to the 'use default' sentinel -1, exactly
+    like the reference (tests/c_api_test/test_.py
+    test_max_thread_control pins this contract)."""
+    _max_threads[0] = int(n) if n > 0 else -1
     os.environ["LGBM_TPU_NUM_THREADS"] = str(n if n > 0 else 0)
 
 
 def get_max_threads() -> int:
-    if _max_threads[0] > 0:
-        return _max_threads[0]
-    return os.cpu_count() or 1
+    return _max_threads[0]
 
 
 def dump_param_aliases() -> str:

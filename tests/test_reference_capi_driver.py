@@ -1,0 +1,64 @@
+"""Run the REFERENCE's own C API test driver, unmodified, against
+lib_lightgbm_tpu.so (ref: tests/c_api_test/test_.py — the reference's
+ctypes smoke test). The driver is imported from its read-only location;
+a synthetic `lightgbm.basic` module hands it our shim as `_LIB`, so the
+exact byte-for-byte reference harness exercises this framework's ABI.
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+SO_PATH = REPO / "lightgbm_tpu" / "lib_lightgbm_tpu.so"
+REF_DRIVER = Path("/root/reference/tests/c_api_test/test_.py")
+
+RUNNER = r"""
+import ctypes, importlib.util, sys, types, tempfile
+from pathlib import Path
+
+so_path, driver_path = sys.argv[1], sys.argv[2]
+# hand the reference driver OUR shim as lightgbm.basic._LIB
+pkg = types.ModuleType("lightgbm")
+basic = types.ModuleType("lightgbm.basic")
+basic._LIB = ctypes.CDLL(so_path)
+pkg.basic = basic
+sys.modules["lightgbm"] = pkg
+sys.modules["lightgbm.basic"] = basic
+
+spec = importlib.util.spec_from_file_location("ref_capi_test", driver_path)
+mod = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(mod)
+
+with tempfile.TemporaryDirectory() as td:
+    mod.test_dataset(Path(td))
+print("REF-DATASET-OK")
+with tempfile.TemporaryDirectory() as td:
+    mod.test_booster(Path(td))
+print("REF-BOOSTER-OK")
+mod.test_max_thread_control()
+print("REF-THREADS-OK")
+"""
+
+
+@pytest.mark.slow
+def test_reference_c_api_driver(tmp_path):
+    if not REF_DRIVER.exists():
+        pytest.skip("reference c_api_test driver not available")
+    from test_capi import _ensure_built
+    _ensure_built()
+    runner = tmp_path / "runner.py"
+    runner.write_text(RUNNER)
+    from lightgbm_tpu.hostenv import cpu_child_env
+    env = cpu_child_env()
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, str(runner), str(SO_PATH), str(REF_DRIVER)],
+        env=env, capture_output=True, text=True, timeout=2400)
+    assert proc.returncode == 0, proc.stderr[-3000:]
+    for marker in ("REF-DATASET-OK", "REF-BOOSTER-OK", "REF-THREADS-OK"):
+        assert marker in proc.stdout, (marker, proc.stdout[-2000:],
+                                       proc.stderr[-2000:])
