@@ -264,18 +264,14 @@ def test_feature_contri_penalty_steers_splits():
     """feature_contri multiplies per-feature split gains (ref:
     feature_contri config.h / FeatureHistogram penalty): strongly
     penalizing the dominant feature must reduce its split count."""
-    import lightgbm_tpu as lgb
     r = np.random.RandomState(11)
     n = 2000
     X = r.randn(n, 4)
     # feature 0 dominant, feature 1 slightly informative
     y = (X[:, 0] + 0.3 * X[:, 1] + 0.2 * r.randn(n) > 0).astype(np.float32)
-    base = {"objective": "binary", "num_leaves": 15, "verbosity": -1,
-            "min_data_in_leaf": 5}
-    b_plain = lgb.train(dict(base), lgb.Dataset(X, label=y),
-                        num_boost_round=10)
-    b_pen = lgb.train({**base, "feature_contri": [0.01, 1, 1, 1]},
-                      lgb.Dataset(X, label=y), num_boost_round=10)
+    b_plain = _train(X, y, {"objective": "binary"})
+    b_pen = _train(X, y, {"objective": "binary",
+                          "feature_contri": [0.01, 1, 1, 1]})
     splits_plain = b_plain.feature_importance("split")
     splits_pen = b_pen.feature_importance("split")
     assert splits_plain[0] > 0
