@@ -8,7 +8,7 @@ objectives/metrics, data-parallel training via jax.sharding over an ICI
 mesh, and a python API mirroring the reference python-package.
 """
 
-from .basic import Booster, Dataset, LightGBMError  # noqa: F401
+from .basic import Booster, Dataset, LightGBMError, Sequence  # noqa: F401
 from .callback import (EarlyStopException, early_stopping,  # noqa: F401
                        log_evaluation, record_evaluation, reset_parameter)
 from .engine import CVBooster, cv, train  # noqa: F401
@@ -17,6 +17,8 @@ from . import plotting  # noqa: F401
 from .plotting import (create_tree_digraph, plot_importance,  # noqa: F401
                        plot_metric, plot_split_value_histogram, plot_tree)
 from .io.streaming import DatasetBuilder  # noqa: F401
+from .dask import (DaskLGBMClassifier, DaskLGBMRanker,  # noqa: F401
+                   DaskLGBMRegressor)
 from .sklearn import (LGBMClassifier, LGBMModel,  # noqa: F401
                       LGBMRanker, LGBMRegressor)
 
@@ -30,4 +32,6 @@ __all__ = [
     "plot_importance", "plot_metric", "plot_split_value_histogram",
     "plot_tree", "create_tree_digraph", "plotting", "DatasetBuilder",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+    "Sequence",
+    "DaskLGBMRegressor", "DaskLGBMClassifier", "DaskLGBMRanker",
 ]

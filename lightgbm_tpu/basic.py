@@ -9,10 +9,12 @@ lib_lightgbm.so.
 
 from __future__ import annotations
 
+import abc
 import json
 from copy import deepcopy
 from pathlib import Path
-from typing import Any, Dict, List, Optional, Sequence, Union
+from typing import Any, Dict, List, Optional, Union
+from typing import Sequence as _SequenceT
 
 import numpy as np
 
@@ -43,6 +45,40 @@ def _to_2d(data):
     return arr
 
 
+class Sequence(abc.ABC):
+    """Generic batched data-access interface (ref: basic.py:841
+    lightgbm.Sequence): subclasses provide random row access
+    (``seq[i]`` -> 1D row, ``seq[a:b]`` -> 2D batch) and ``len(seq)``;
+    ``batch_size`` bounds how many rows are read per range access.
+    A Dataset accepts one Sequence or a list of them (row-concatenated)
+    and reads through them in batches, so producers never hand over one
+    giant in-memory matrix."""
+
+    batch_size: int = 4096
+
+    @abc.abstractmethod
+    def __getitem__(self, idx):
+        raise NotImplementedError("scikit-learn requires __getitem__")
+
+    @abc.abstractmethod
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+
+def _materialize_sequences(seqs) -> np.ndarray:
+    """Batched read-through of one or more Sequence objects -> [N, F]."""
+    parts = []
+    for seq in seqs:
+        n = len(seq)
+        bs = max(int(getattr(seq, "batch_size", 4096) or 4096), 1)
+        for lo in range(0, n, bs):
+            batch = np.asarray(seq[lo:min(lo + bs, n)], np.float64)
+            parts.append(batch if batch.ndim == 2 else batch[None, :])
+    if not parts:
+        raise LightGBMError("empty Sequence data")
+    return np.concatenate(parts, axis=0)
+
+
 class Dataset:
     """Lazily-constructed training dataset (ref: basic.py:1692)."""
 
@@ -52,6 +88,16 @@ class Dataset:
                  categorical_feature: Union[str, List] = "auto",
                  params: Optional[Dict[str, Any]] = None,
                  free_raw_data: bool = False, position=None):
+        if isinstance(data, Sequence):
+            data = _materialize_sequences([data])
+        elif isinstance(data, (list, tuple)) and data and any(
+                isinstance(s, Sequence) for s in data):
+            if not all(isinstance(s, Sequence) for s in data):
+                raise TypeError(
+                    "a chunked Dataset input must be a list of Sequence "
+                    "objects only (mixed Sequence/array lists are not "
+                    "supported)")
+            data = _materialize_sequences(data)
         if isinstance(data, (str, Path)):
             path = str(data)
             with open(path, "rb") as fh:
@@ -261,7 +307,7 @@ class Dataset:
     def get_feature_name(self) -> List[str]:
         return self._feature_names()
 
-    def subset(self, used_indices: Sequence[int],
+    def subset(self, used_indices: _SequenceT[int],
                params: Optional[Dict] = None) -> "Dataset":
         """Row-subset view (ref: basic.py Dataset.subset)."""
         if self.data is None:

@@ -42,7 +42,7 @@ def _free_port() -> int:
 
 def worker_train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
                  *, coordinator: str, num_workers: int, rank: int,
-                 weight=None, num_boost_round: int = 100,
+                 weight=None, group=None, num_boost_round: int = 100,
                  out_model: Optional[str] = None) -> Optional[str]:
     """One worker's training step (the _train_part analog,
     ref: dask.py:196): join the runtime, sync bins with rank 0, train
@@ -55,7 +55,8 @@ def worker_train(params: Dict[str, Any], X: np.ndarray, y: np.ndarray,
     params = dict(params)
     params.setdefault("tree_learner", "data")
     params.setdefault("enable_bundle", False)  # not yet multi-host safe
-    ds = Dataset(X, label=y, weight=weight, params=dict(params))
+    ds = Dataset(X, label=y, weight=weight, group=group,
+                 params=dict(params))
     ds.construct()
     dist.sync_dataset(ds)
     bst = Booster(params, ds)
@@ -84,7 +85,7 @@ part = payload["parts"][rank]
 text = worker_train(payload["params"], part["X"], part["y"],
                     coordinator=payload["coordinator"],
                     num_workers=len(payload["parts"]), rank=rank,
-                    weight=part.get("weight"),
+                    weight=part.get("weight"), group=part.get("group"),
                     num_boost_round=payload["num_boost_round"],
                     out_model=payload["out_model"] if rank == 0 else None)
 print(f"worker {rank} finished", flush=True)
@@ -100,15 +101,25 @@ def train_distributed(params: Dict[str, Any], parts: List[Dict[str, Any]],
     tests; on real multi-host TPU, launch workers yourself and call
     `worker_train`).
 
-    parts: list of {"X": [n_i, F], "y": [n_i], optional "weight"} dicts.
+    parts: list of {"X": [n_i, F], "y": [n_i], optional "weight",
+    optional "group" (per-partition query sizes, for ranking)} dicts.
     Returns a Booster loaded from the distributed model.
     """
     from . import Booster
 
     if not parts:
         raise ValueError("no partitions")
-    for p in parts:
-        n = np.asarray(p["X"]).shape[0]
+    sizes = [np.asarray(p["X"]).shape[0] for p in parts]
+    if len(set(sizes)) > 1:
+        # the multi-host assembly requires equal shards per process
+        # (parallel/distributed.make_global_array; the reference's
+        # distributed tests pre-partition equally too)
+        raise ValueError(
+            f"distributed training requires equal-size partitions, got "
+            f"{sizes}; repartition the input (for ranking, choose a "
+            "partition count that splits the queries into equal row "
+            "blocks)")
+    for n in sizes:
         if n % devices_per_worker != 0:
             raise ValueError(
                 f"partition of {n} rows not divisible by "

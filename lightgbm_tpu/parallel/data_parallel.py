@@ -115,6 +115,49 @@ class _DataParallelMixin:
             lambda a: jax.device_put(np.asarray(a),
                                      NamedSharding(mesh, P())),
             self.feature_meta)
+        # ranking objectives hold query-padded state whose shape/content
+        # is per-process under local init; rebuild them from GLOBAL
+        # metadata (labels + query sizes allgathered in process order,
+        # matching the row-shard order) so every process carries the
+        # IDENTICAL global state — the global program then computes
+        # exact global lambdas, where the reference's distributed
+        # lambdarank approximates with machine-local ones
+        # (rank_objective.hpp works per-machine)
+        if self.objective is not None and getattr(
+                self.objective, "is_ranking", False):
+            from jax.experimental import multihost_utils as mh
+            from ..dataset import Metadata
+            meta_l = self.train_set.metadata
+            if meta_l.query_boundaries is None:
+                raise ValueError(
+                    "ranking objective requires group/query data on "
+                    "every worker's partition")
+            sizes_l = np.diff(meta_l.query_boundaries).astype(np.int64)
+            nproc = jax.process_count()
+            nq = np.asarray(mh.process_allgather(
+                np.asarray([len(sizes_l)], np.int64))).reshape(-1)
+            maxq = int(nq.max())
+            pad_sizes = np.zeros(maxq, np.int64)
+            pad_sizes[:len(sizes_l)] = sizes_l
+            all_sizes = np.asarray(
+                mh.process_allgather(pad_sizes)).reshape(nproc, maxq)
+            glob_sizes = np.concatenate(
+                [all_sizes[p, :int(nq[p])] for p in range(nproc)])
+            lab = np.asarray(mh.process_allgather(np.asarray(
+                meta_l.label, np.float32))).reshape(-1)
+            total_n = int(lab.shape[0])
+            gmeta = Metadata(total_n)
+            gmeta.set_label(lab)
+            gmeta.set_group(glob_sizes)
+            if meta_l.weight is not None:
+                gmeta.set_weight(np.asarray(mh.process_allgather(
+                    np.asarray(meta_l.weight, np.float32))).reshape(-1))
+            if meta_l.positions is not None:
+                gmeta.positions = np.asarray(mh.process_allgather(
+                    np.asarray(meta_l.positions,
+                               np.int32))).reshape(-1)
+            self.objective.init(gmeta, total_n)
+
         # objective device buffers: [N_local]-leading arrays become row
         # shards of the global array; everything else is replicated
         if self.objective is not None:

@@ -192,3 +192,36 @@ def test_streaming_builder_validation():
     assert ds._binned.num_data == 6
     with pytest.raises(RuntimeError):
         b.finalize()
+
+
+
+def test_sequence_interface_matches_array():
+    """lightgbm.Sequence analog (ref: basic.py:841): batched read-through
+    must produce the identical model to direct array input, including a
+    LIST of sequences (row-concatenated chunks)."""
+    import lightgbm_tpu as lgb
+
+    class ArrSeq(lgb.Sequence):
+        batch_size = 128
+
+        def __init__(self, a):
+            self.a = a
+
+        def __getitem__(self, idx):
+            return self.a[idx]
+
+        def __len__(self):
+            return len(self.a)
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(700, 5)
+    y = (X[:, 0] > 0).astype(np.float32)
+    p = {"objective": "binary", "num_leaves": 7, "verbosity": -1}
+    b_arr = lgb.train(dict(p), lgb.Dataset(X, label=y), num_boost_round=4)
+    b_seq = lgb.train(dict(p), lgb.Dataset(ArrSeq(X), label=y),
+                      num_boost_round=4)
+    b_lst = lgb.train(dict(p),
+                      lgb.Dataset([ArrSeq(X[:300]), ArrSeq(X[300:])],
+                                  label=y), num_boost_round=4)
+    np.testing.assert_allclose(b_seq.predict(X), b_arr.predict(X))
+    np.testing.assert_allclose(b_lst.predict(X), b_arr.predict(X))
