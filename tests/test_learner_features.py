@@ -278,3 +278,44 @@ def test_feature_contri_penalty_steers_splits():
     assert splits_pen[0] < splits_plain[0]
     # the penalized model leans on other features instead
     assert splits_pen[1:].sum() > splits_plain[1:].sum()
+
+
+def test_monotone_penalty_delays_constrained_splits():
+    """monotone_penalty discounts gains of monotone-constrained features
+    near the root (ref: feature_histogram.hpp monotone_penalty factor):
+    with a large penalty the dominant constrained feature loses the
+    root split."""
+    r = np.random.RandomState(12)
+    n = 2000
+    X = r.randn(n, 3)
+    y = (2.0 * X[:, 0] + 0.5 * X[:, 1] + 0.1 * r.randn(n)).astype(
+        np.float32)
+    common = {"monotone_constraints": [1, 0, 0], "num_leaves": 15}
+
+    def root_feature(bst):
+        tree0 = bst._gbdt.models[0][0]
+        return int(tree0.split_feature_inner[0])
+
+    b_plain = _train(X, y, dict(common))
+    b_pen = _train(X, y, {**common, "monotone_penalty": 4.0})
+    assert root_feature(b_plain) == 0  # dominant constrained feature
+    assert root_feature(b_pen) != 0   # penalty pushed it off the root
+
+
+def test_refit_decay_rate_blends_leaf_values():
+    """refit leaf values are decay*old + (1-decay)*new (ref:
+    GBDT::RefitTree refit_decay_rate): decay 1.0 reproduces the original
+    model, decay 0.0 moves furthest from it."""
+    r = np.random.RandomState(13)
+    X = r.randn(1200, 4)
+    y = (X[:, 0] + 0.2 * r.randn(1200)).astype(np.float32)
+    bst = _train(X[:800], y[:800], {"objective": "regression"})
+    base = bst.predict(X[800:])
+    X2, y2 = X[800:], y[800:] + 1.0  # shifted target
+    keep = bst.refit(X2, y2, decay_rate=1.0).predict(X2)
+    mid = bst.refit(X2, y2, decay_rate=0.5).predict(X2)
+    full = bst.refit(X2, y2, decay_rate=0.0).predict(X2)
+    np.testing.assert_allclose(keep, base, rtol=1e-6, atol=1e-6)
+    # decay 0 adapts most to the shifted target
+    assert np.abs(full - (y2)).mean() < np.abs(mid - (y2)).mean() \
+        < np.abs(keep - (y2)).mean()
