@@ -221,3 +221,79 @@ def test_forcedbins_filename(tmp_path):
     ubs = np.asarray(m.bin_upper_bound, np.float64)
     for b in (2.5, 5.0, 7.5):
         assert np.any(np.isclose(ubs, b)), (b, ubs)
+
+
+def test_max_cat_to_onehot_switches_split_style():
+    """Categories <= max_cat_to_onehot use one-vs-rest splits; above it
+    the sorted-subset scan can send MULTIPLE categories left (ref:
+    feature_histogram.hpp one-hot vs sorted categorical paths)."""
+    r = np.random.RandomState(21)
+    n = 3000
+    cat = r.randint(0, 12, n)
+    y = (np.isin(cat, [1, 4, 7, 9]) * 2.0 - 1.0
+         + 0.3 * r.randn(n)).astype(np.float32)
+    X = cat.astype(np.float64)[:, None]
+
+    def root_left_cats(bst):
+        tree = bst._gbdt.models[0][0]
+        assert tree.decision_type[0] & 1
+        ci = int(tree.threshold[0])
+        words = tree.cat_threshold[tree.cat_boundaries[ci]:
+                                   tree.cat_boundaries[ci + 1]]
+        return [w * 32 + b for w, word in enumerate(words)
+                for b in range(32) if word >> b & 1]
+
+    params = {"objective": "regression", "verbosity": -1,
+              "num_leaves": 7, "min_data_in_leaf": 5}
+    # sorted-subset mode (threshold below the 12 categories): the root
+    # split groups several of the 4 positive categories at once
+    b_sub = lgb.train({**params, "max_cat_to_onehot": 2},
+                      lgb.Dataset(X, label=y, categorical_feature=[0]),
+                      num_boost_round=2)
+    assert len(root_left_cats(b_sub)) > 1
+    # one-hot mode: exactly one category per split
+    b_hot = lgb.train({**params, "max_cat_to_onehot": 32},
+                      lgb.Dataset(X, label=y, categorical_feature=[0]),
+                      num_boost_round=2)
+    assert len(root_left_cats(b_hot)) == 1
+
+
+def test_cat_l2_regularizes_categorical_gain():
+    """cat_l2 adds extra L2 to categorical splits (ref:
+    feature_histogram.hpp cat_l2): a huge value suppresses categorical
+    splits in favor of numerical ones."""
+    r = np.random.RandomState(22)
+    n = 2000
+    cat = r.randint(0, 10, n)
+    num = r.randn(n)
+    y = (np.isin(cat, [2, 5]) * 1.5 + 0.7 * num
+         + 0.2 * r.randn(n)).astype(np.float32)
+    X = np.column_stack([cat.astype(np.float64), num])
+    params = {"objective": "regression", "verbosity": -1,
+              "num_leaves": 7, "min_data_in_leaf": 5}
+    b0 = lgb.train(dict(params),
+                   lgb.Dataset(X, label=y, categorical_feature=[0]),
+                   num_boost_round=3)
+    b1 = lgb.train({**params, "cat_l2": 1e6},
+                   lgb.Dataset(X, label=y, categorical_feature=[0]),
+                   num_boost_round=3)
+    cat_splits0 = b0.feature_importance("split")[0]
+    cat_splits1 = b1.feature_importance("split")[0]
+    assert cat_splits0 > 0
+    assert cat_splits1 < cat_splits0
+
+
+def test_min_sum_hessian_in_leaf_limits_leaves():
+    """min_sum_hessian_in_leaf blocks low-mass leaves (ref:
+    feature_histogram.hpp min_sum_hessian check)."""
+    X, y = make_regression(600)
+    b0 = lgb.train({"objective": "regression", "verbosity": -1,
+                    "num_leaves": 63, "min_data_in_leaf": 1},
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    b1 = lgb.train({"objective": "regression", "verbosity": -1,
+                    "num_leaves": 63, "min_data_in_leaf": 1,
+                    "min_sum_hessian_in_leaf": 100.0},
+                   lgb.Dataset(X, label=y), num_boost_round=3)
+    n0 = sum(t.num_leaves for it in b0._gbdt.models for t in it)
+    n1 = sum(t.num_leaves for it in b1._gbdt.models for t in it)
+    assert n1 < n0

@@ -247,3 +247,4 @@ class TestGrowTree:
                 continue
             expect = -g[sel].sum() / sel.sum()
             assert sums[leaf] == pytest.approx(expect, abs=1e-3)
+
