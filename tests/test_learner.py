@@ -247,4 +247,3 @@ class TestGrowTree:
                 continue
             expect = -g[sel].sum() / sel.sum()
             assert sums[leaf] == pytest.approx(expect, abs=1e-3)
-
