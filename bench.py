@@ -26,6 +26,7 @@ and platform recorded in `unit`.
 
 import json
 import os
+import re
 import signal
 import socket
 import subprocess
@@ -36,6 +37,18 @@ import numpy as np
 
 BASELINE_IPS = 500.0 / 130.094  # reference CPU Higgs-10.5M iters/sec
 RELAY_PORTS = (8082, 8083, 8087)
+
+# XLA/absl startup spam (machine-feature warnings, duplicate-registration
+# errors) that would otherwise pollute the stderr tail captured into
+# BENCH_*.json: abseil-prefixed log lines and the pre-init banner
+_STDERR_SPAM = re.compile(
+    r"^(?:[EWIF]\d{4} |WARNING: All log messages before absl)")
+
+
+def _telemetry_enabled() -> bool:
+    return (os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0")
+            or os.environ.get("LGBM_TPU_TELEMETRY", "") not in ("", "0")
+            or bool(os.environ.get("LGBM_TPU_TRACE", "")))
 
 
 def _relay_up() -> bool:
@@ -69,21 +82,52 @@ def _run_child(rows: int, platform: str, timeout: float,
     env["BENCH_CHILD"] = "1"
     env["BENCH_ROWS"] = str(rows)
     env["BENCH_OUT"] = out_path
-    proc = subprocess.Popen([sys.executable, os.path.abspath(__file__)],
-                            env=env)
+    # child stderr goes through a file so XLA startup spam can be
+    # filtered before it reaches the driver's captured tail
+    import tempfile
+    with tempfile.NamedTemporaryFile("w+", suffix=".stderr",
+                                     delete=False) as ef:
+        err_path = ef.name
+    rc = -1
     try:
-        return proc.wait(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        print(f"# bench attempt timed out after {timeout:.0f}s "
-              f"(rows={rows}, platform={platform}); SIGTERM",
-              file=sys.stderr)
-        proc.send_signal(signal.SIGTERM)
+        with open(err_path, "w") as err_fh:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, stderr=err_fh)
+            try:
+                rc = proc.wait(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                print(f"# bench attempt timed out after {timeout:.0f}s "
+                      f"(rows={rows}, platform={platform}); SIGTERM",
+                      file=sys.stderr)
+                proc.send_signal(signal.SIGTERM)
+                try:
+                    proc.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    # Leave it; do NOT SIGKILL a TPU-attached process.
+                    print("# child ignored SIGTERM; abandoning it",
+                          file=sys.stderr)
+                rc = -1
+    finally:
+        _replay_child_stderr(err_path)
         try:
-            proc.wait(timeout=60)
-        except subprocess.TimeoutExpired:
-            # Leave it; do NOT SIGKILL a TPU-attached process.
-            print("# child ignored SIGTERM; abandoning it", file=sys.stderr)
-        return -1
+            os.unlink(err_path)
+        except OSError:
+            pass
+    return rc
+
+
+def _replay_child_stderr(path: str) -> None:
+    """Forward the child's stderr minus the XLA machine-feature spam."""
+    try:
+        with open(path, errors="replace") as fh:
+            for line in fh:
+                if _STDERR_SPAM.match(line):
+                    continue
+                sys.stderr.write(line)
+        sys.stderr.flush()
+    except OSError:
+        pass
 
 
 def main():
@@ -156,6 +200,13 @@ def _measure():
     f = 28
     iters = int(os.environ.get("BENCH_ITERS", 10))
     warmup = 2
+
+    telemetry = _telemetry_enabled()
+    if telemetry:
+        # record spans for the phase-time summary folded into the JSON
+        # line below (export/exit-print still follow the env knobs)
+        from lightgbm_tpu.obs import global_tracer
+        global_tracer.enable()
 
     import jax
     # persistent compilation cache: a retried/repeated bench attempt (or
@@ -230,6 +281,16 @@ def _measure():
         "unit": unit,
         "vs_baseline": round(iters_per_sec / BASELINE_IPS, 4),
     }
+    if telemetry:
+        # fold the phase-time summary into the one JSON line instead of
+        # leaving it buried in raw stderr
+        from lightgbm_tpu.obs import global_tracer
+        phases = {"bin_seconds": round(bin_time, 3),
+                  "warmup_compile_seconds": round(warm_time, 3),
+                  "per_iter_seconds": round(dt, 4)}
+        for name, agg in global_tracer.summary().items():
+            phases[name] = round(agg["seconds"], 4)
+        result["phases"] = phases
     out_path = os.environ.get("BENCH_OUT")
     if out_path:  # orchestrated: parent prints the single contract line
         with open(out_path, "w") as fh:

@@ -233,8 +233,8 @@ class Dataset:
             forced_bins = {int(e["feature"]): e["bin_upper_bound"]
                            for e in spec}
 
-        from .timer import global_timer
-        with global_timer.timed("data/binning"):
+        from .obs.trace import global_tracer
+        with global_tracer.span("data/binning"):
             if _is_sparse(self.data):
                 self._binned = BinnedDataset.from_sparse(
                     self.data, cfg, metadata=meta,
@@ -375,6 +375,11 @@ class Booster:
         self.config = Config.from_params(self.params)
         from . import log
         log.set_verbosity(self.config.verbosity)
+        if self.config.trace_output:
+            # param twin of LGBM_TPU_TRACE: record spans for this run and
+            # write a Chrome trace at exit (obs/trace.py)
+            from .obs.trace import global_tracer
+            global_tracer.enable(path=self.config.trace_output)
         train_set.params = {**train_set.params, **self.params}
         train_set.construct()
         self.train_set = train_set

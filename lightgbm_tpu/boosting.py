@@ -27,7 +27,9 @@ import jax.numpy as jnp
 from .config import Config
 from .dataset import BinnedDataset
 from .learner import grow_tree, grow_tree_waved, replay_tree
-from .timer import global_timer
+from .obs.metrics import global_metrics
+from .obs.trace import global_tracer
+from .timer import global_timer  # noqa: F401  (compat facade re-export)
 from .objectives import ObjectiveFunction, create_objective
 from .ops import histogram as hist_ops
 from .ops.split import FeatureMeta, SplitHyperParams, leaf_output
@@ -290,7 +292,8 @@ class GBDT:
         self._use_node_rand = (self.config.extra_trees or
                                self.config.feature_fraction_bynode < 1.0)
         self._extra_key = jax.random.PRNGKey(self.config.extra_seed)
-        self._grow = jax.jit(self._grow_partial())
+        self._grow = jax.jit(global_metrics.wrap_traced(
+            "boosting/grow", self._grow_partial()))
         self._fused = None
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
@@ -588,14 +591,16 @@ class GBDT:
                 if obj is not None:
                     obj.swap_device_state(old_state)
 
-        return jax.jit(fused, donate_argnums=(3, 4, 5))
+        return jax.jit(global_metrics.wrap_traced("boosting/fused_iter",
+                                                  fused),
+                       donate_argnums=(3, 4, 5))
 
     def _train_one_iter_fast(self) -> bool:
         self._boost_from_average()
         if self._fused is None:
-            with global_timer.timed("train/compile_fused"):
+            with global_tracer.span("train/compile_fused"):
                 self._fused = self._make_fused()
-        with global_timer.timed("train/iteration",
+        with global_tracer.span("train/iteration",
                                 block=lambda: self.scores):
             (self.scores, self._sample_mask, valid, recs,
              new_obj_state) = self._fused(
@@ -613,7 +618,7 @@ class GBDT:
     def _materialize_records(self) -> None:
         if not self._device_records:
             return
-        with global_timer.timed("train/materialize_trees"):
+        with global_tracer.span("train/materialize_trees"):
             self._materialize_records_inner()
 
     def _materialize_records_inner(self) -> None:
@@ -741,13 +746,100 @@ class GBDT:
     # ------------------------------------------------------------------
     def train_one_iter(self, custom_grad=None, custom_hess=None) -> bool:
         """Returns True when training should stop (no splittable leaves),
-        matching the reference return convention (gbdt.cpp:353)."""
+        matching the reference return convention (gbdt.cpp:353).
+
+        With telemetry on (obs.metrics), each call opens a per-iteration
+        metrics record; disabled mode is a single attribute check."""
+        if not global_metrics.enabled:
+            return self._train_one_iter_impl(custom_grad, custom_hess)
+        global_metrics.begin_iteration(self.iter)
+        n_dev0, n_host0 = len(self._device_records), len(self._host_models)
+        self._observe_safely(self._observe_gradient_metrics,
+                             custom_grad, custom_hess)
+        try:
+            return self._train_one_iter_impl(custom_grad, custom_hess)
+        finally:
+            self._observe_safely(self._observe_tree_metrics, n_dev0, n_host0)
+            global_metrics.end_iteration()
+
+    @staticmethod
+    def _observe_safely(fn, *args) -> None:
+        """Telemetry must never kill training (e.g. eager norm ops on
+        multi-host sharded arrays can be unsupported)."""
+        try:
+            fn(*args)
+        except Exception as exc:
+            from . import log
+            log.debug(f"telemetry observation failed: {exc!r}")
+
+    def _observe_gradient_metrics(self, custom_grad, custom_hess) -> None:
+        """Gradient norms / clip counts for the iteration about to run
+        (telemetry-enabled path only — recomputes gradients from the
+        current scores, so it adds one gradient pass)."""
+        m = global_metrics
+        if custom_grad is not None:
+            g = np.asarray(custom_grad, np.float32)
+            h = np.asarray(custom_hess, np.float32)
+            m.observe("grad_norm", float(np.linalg.norm(g)))
+            m.observe("hess_norm", float(np.linalg.norm(h)))
+            m.observe("grad_nonfinite", int(np.sum(~np.isfinite(g))))
+            return
+        if self.objective is None:
+            return
+        # iteration 0 gradients are taken AFTER the init score lands
+        # (idempotent; both train paths apply it before their gradients)
+        self._boost_from_average()
+        with global_tracer.span("train/telemetry_gradients"):
+            g, h = self._grad_fn(self.scores)
+            g_abs = jnp.abs(g)
+            m.observe("grad_norm", float(jnp.linalg.norm(g)))
+            m.observe("hess_norm", float(jnp.linalg.norm(h)))
+            m.observe("grad_nonfinite", int(jnp.sum(~jnp.isfinite(g))))
+            if self._quant_enabled:
+                # entries landing in the extreme quantization bin — the
+                # discretizer's saturation count (ref:
+                # gradient_discretizer.cpp DiscretizeGradients)
+                bins = max(int(self.config.num_grad_quant_bins), 2)
+                g_scale = jnp.maximum(jnp.max(g_abs), K_EPSILON) / (bins // 2)
+                m.observe("grad_clipped", int(jnp.sum(
+                    g_abs >= g_scale * (bins // 2 - 0.5))))
+
+    def _observe_tree_metrics(self, n_dev0: int, n_host0: int) -> None:
+        """Leaves grown / split-gain stats of the iteration that just
+        finished, plus sampled-row count (telemetry-enabled path only)."""
+        m = global_metrics
+        gains = None
+        if len(self._device_records) > n_dev0:
+            rec = self._device_records[-1]  # stacked [K, ...] TreeArrays
+            nl, gains = jax.device_get((rec.num_leaves, rec.split_gain))
+            m.observe("leaves_grown", int(np.sum(nl)))
+            gains = np.asarray(gains).reshape(-1)
+        elif len(self._host_models) > n_host0:
+            trees = self._host_models[-1]
+            m.observe("leaves_grown",
+                      int(sum(t.num_leaves for t in trees)))
+            gains = np.concatenate(
+                [np.asarray(t.split_gain[:t.num_internal], np.float64)
+                 for t in trees]) if trees else np.zeros(0)
+        if gains is not None:
+            pos = gains[gains > 0]
+            m.observe("splits_made", int(pos.size))
+            if pos.size:
+                m.observe("best_gain", float(pos.max()))
+                m.observe("mean_split_gain", float(pos.mean()))
+        m.observe("sampled_rows", int(jnp.sum(self._sample_mask)))
+
+    def _train_one_iter_impl(self, custom_grad=None,
+                             custom_hess=None) -> bool:
         if self._fast_path_ok(custom_grad):
             return self._train_one_iter_fast()
         if custom_grad is None:
             self._boost_from_average()
-        grad_all, hess_all = self._gradients(custom_grad, custom_hess)
-        self._resample_mask()
+        with global_tracer.span("train/gradients",
+                                block=lambda: grad_all):
+            grad_all, hess_all = self._gradients(custom_grad, custom_hess)
+        with global_tracer.span("train/sampling"):
+            self._resample_mask()
 
         iter_trees: List[Tree] = []
         should_continue = False
@@ -756,8 +848,9 @@ class GBDT:
             mask = self._sample_mask
             if self.config.data_sample_strategy == "goss" and \
                     custom_grad is None:
-                mask, scale = self._goss_mask(grad, hess)
-                grad, hess = grad * scale, hess * scale
+                with global_tracer.span("train/sampling"):
+                    mask, scale = self._goss_mask(grad, hess)
+                    grad, hess = grad * scale, hess * scale
             true_grad, true_hess = grad, hess
             if self._quant_enabled:
                 qkey = jax.random.fold_in(self._bagging_key,
@@ -769,10 +862,12 @@ class GBDT:
                 self._extra_key,
                 self.iter * self.num_tree_per_iteration + k)
                 if self._use_node_rand else None)
-            record, row_leaf = self._grow(
-                self.bins_fm, grad, hess, mask, feature_mask,
-                self.feature_meta, self.hp, self.max_depth, self._forced,
-                node_key)
+            with global_tracer.span("train/grow",
+                                    block=lambda: record.leaf_value):
+                record, row_leaf = self._grow(
+                    self.bins_fm, grad, hess, mask, feature_mask,
+                    self.feature_meta, self.hp, self.max_depth, self._forced,
+                    node_key)
             if self._quant_enabled and \
                     self.config.quant_train_renew_leaf:
                 record = self._renew_leaves_in_jit(
@@ -803,21 +898,23 @@ class GBDT:
                         np.asarray(true_hess), np.asarray(mask),
                         self.config.linear_lambda)
                 tree.apply_shrinkage(self._tree_shrinkage())
-                if tree.is_linear:
-                    # within-leaf outputs vary by row: linear outputs over
-                    # the grower's row->leaf map (no re-traversal)
-                    vals = tree.predict_given_leaves(
-                        np.asarray(self.train_set.raw_data, np.float64),
-                        np.asarray(row_leaf))
-                    new_score_k = self.scores[k] + jnp.asarray(
-                        vals.astype(np.float32))
-                else:
-                    leaf_vals = jnp.asarray(
-                        tree.leaf_value.astype(np.float32))
-                    new_score_k = self._update_score(self.scores[k],
-                                                     leaf_vals, row_leaf)
-                self.scores = self.scores.at[k].set(new_score_k)
-                self._update_valid_scores(tree, k)
+                with global_tracer.span("train/update_score",
+                                        block=lambda: self.scores):
+                    if tree.is_linear:
+                        # within-leaf outputs vary by row: linear outputs
+                        # over the grower's row->leaf map (no re-traversal)
+                        vals = tree.predict_given_leaves(
+                            np.asarray(self.train_set.raw_data, np.float64),
+                            np.asarray(row_leaf))
+                        new_score_k = self.scores[k] + jnp.asarray(
+                            vals.astype(np.float32))
+                    else:
+                        leaf_vals = jnp.asarray(
+                            tree.leaf_value.astype(np.float32))
+                        new_score_k = self._update_score(self.scores[k],
+                                                         leaf_vals, row_leaf)
+                    self.scores = self.scores.at[k].set(new_score_k)
+                    self._update_valid_scores(tree, k)
                 if abs(self.init_scores[k]) > K_EPSILON and \
                         len(self.models) == 0:
                     tree.add_bias(self.init_scores[k])
@@ -1077,8 +1174,10 @@ class GBDT:
             return self._predict_raw_host(data, start_iteration, end)
         from .ops.predict import predict_raw_cached
         key = (start_iteration, end, self.current_iteration())
-        return predict_raw_cached(self, trees, self.num_tree_per_iteration,
-                                  data, key, self._PREDICT_CHUNK)
+        with global_tracer.span("predict/raw"):
+            return predict_raw_cached(self, trees,
+                                      self.num_tree_per_iteration,
+                                      data, key, self._PREDICT_CHUNK)
 
     def _predict_raw_host(self, data: np.ndarray, start_iteration: int,
                           end: int) -> np.ndarray:
@@ -1408,7 +1507,9 @@ class DART(GBDT):
             finally:
                 obj.swap_device_state(old_state)
 
-        return jax.jit(fused, donate_argnums=(3, 4, 5, 6, 7, 8, 9))
+        return jax.jit(global_metrics.wrap_traced("boosting/fused_dart_iter",
+                                                  fused),
+                       donate_argnums=(3, 4, 5, 6, 7, 8, 9))
 
     def _train_one_iter_fast(self) -> bool:
         """Fused DART iteration (the DART twin of the GBDT fast path)."""
@@ -1416,14 +1517,15 @@ class DART(GBDT):
         self._ensure_dart_state()
         drop_slots = self._select_drop(self._dart_t)
         n_drop = len(drop_slots)
+        global_metrics.observe("dart_dropped_trees", n_drop)
         d_cap = max(int(self.config.max_drop), 1)
         dropped = np.full(d_cap, -1, np.int32)
         dropped[:n_drop] = drop_slots
         if self._dart_fused is None:
-            with global_timer.timed("train/compile_fused"):
+            with global_tracer.span("train/compile_fused"):
                 self._dart_fused = self._make_fused_dart()
         st = self._dart
-        with global_timer.timed("train/iteration",
+        with global_tracer.span("train/iteration",
                                 block=lambda: self.scores):
             (self.scores, self._sample_mask, valid, recs, new_obj_state,
              st["leaf_hist"], vhist, st["leaf_vals"],
@@ -1533,7 +1635,8 @@ class DART(GBDT):
             w = self._tree_weights.pop()
             self._sum_tree_weight -= w
 
-    def train_one_iter(self, custom_grad=None, custom_hess=None) -> bool:
+    def _train_one_iter_impl(self, custom_grad=None,
+                             custom_hess=None) -> bool:
         if self._fast_path_ok(custom_grad):
             return self._train_one_iter_fast()
         if self._dart_t > 0 or self._device_records:
@@ -1541,13 +1644,14 @@ class DART(GBDT):
         self._dart_fast_disabled = True
         drop_idx = [self._num_init_iteration + i for i in self._select_drop(
             len(self.models) - self._num_init_iteration)]
+        global_metrics.observe("dart_dropped_trees", len(drop_idx))
         # subtract dropped trees from scores (dart.hpp DroppingTrees)
         for di in drop_idx:
             self._add_tree_scores(self.models[di], sign=-1.0)
 
         new_factor, _old = self._dart_factors(len(drop_idx))
         self._cur_shrinkage = new_factor
-        stop = super().train_one_iter(custom_grad, custom_hess)
+        stop = super()._train_one_iter_impl(custom_grad, custom_hess)
         if not stop:
             self._normalize(drop_idx)
             # the new tree's weight is its actual applied factor

@@ -6,10 +6,14 @@ import collections
 from . import log
 from typing import Callable, Dict, List
 
+# `telemetry` (defaulted, so positional construction stays compatible)
+# carries the obs.metrics per-iteration dict when telemetry is enabled,
+# the way evaluation_result_list carries metric evals
 CallbackEnv = collections.namedtuple(
     "CallbackEnv",
     ["model", "params", "iteration", "begin_iteration", "end_iteration",
-     "evaluation_result_list"])
+     "evaluation_result_list", "telemetry"],
+    defaults=[None])
 
 
 class EarlyStopException(Exception):
@@ -58,6 +62,72 @@ def record_evaluation(eval_result: Dict) -> Callable:
                 .setdefault(metric, []).append(value)
     _callback.order = 20
     _callback.needs_eval = True
+    return _callback
+
+
+def _fmt_telemetry(t: Dict) -> str:
+    """One compact line per iteration: headline numbers then phase times."""
+    parts = []
+    if "iteration_seconds" in t:
+        parts.append(f"iter={t['iteration_seconds']:.3f}s")
+    for key in ("leaves_grown", "best_gain", "grad_norm", "hess_norm",
+                "grad_clipped", "jit_recompiles"):
+        if key in t:
+            v = t[key]
+            parts.append(f"{key}={v:.4g}" if isinstance(v, float)
+                         else f"{key}={v}")
+    phases = t.get("phases") or {}
+    for name in sorted(phases, key=phases.get, reverse=True)[:4]:
+        parts.append(f"{name}={phases[name]:.3f}s")
+    return " ".join(parts)
+
+
+def log_telemetry(period: int = 1) -> Callable:
+    """Print the obs.metrics per-iteration summary every `period`
+    iterations (the telemetry analog of log_evaluation; enables the
+    metrics registry for the run when attached)."""
+    def _callback(env: CallbackEnv) -> None:
+        if period > 0 and env.telemetry is not None and \
+                (env.iteration + 1) % period == 0:
+            log.info(f"[{env.iteration + 1}]\t"
+                     f"{_fmt_telemetry(env.telemetry)}", force=True)
+    _callback.order = 15
+    _callback.needs_telemetry = True
+    return _callback
+
+
+def record_telemetry(result: Dict) -> Callable:
+    """Append each iteration's telemetry dict into `result` as lists
+    keyed by metric name (the telemetry analog of record_evaluation;
+    enables the metrics registry for the run when attached).
+
+    Lists stay iteration-aligned: a metric absent on some iteration
+    (e.g. jit_recompiles only appears on compiling iterations) records
+    None there, so ``result[k][i]`` is always iteration i."""
+    if not isinstance(result, dict):
+        raise TypeError("result must be a dict")
+    n_seen = [0]
+
+    def _callback(env: CallbackEnv) -> None:
+        t = env.telemetry
+        if t is None:
+            return
+        flat = {}
+        for key, value in t.items():
+            if key == "phases":
+                for pname, secs in value.items():
+                    flat[f"phase/{pname}"] = secs
+            else:
+                flat[key] = value
+        for key, value in flat.items():
+            # back-fill iterations recorded before this key first appeared
+            result.setdefault(key, [None] * n_seen[0]).append(value)
+        n_seen[0] += 1
+        for lst in result.values():
+            if len(lst) < n_seen[0]:  # key missing this iteration
+                lst.append(None)
+    _callback.order = 25
+    _callback.needs_telemetry = True
     return _callback
 
 

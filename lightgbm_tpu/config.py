@@ -166,6 +166,7 @@ _ALIASES: Dict[str, List[str]] = {
     "quant_train_renew_leaf": [],
     "stochastic_rounding": [],
     # TPU-specific knobs (new in this framework)
+    "trace_output": ["trace_file", "trace_path"],
     "tpu_hist_dtype": [],
     "tpu_num_shards": [],
     "tpu_donate_buffers": [],
@@ -428,6 +429,11 @@ class Config:
     num_grad_quant_bins: int = 4
     quant_train_renew_leaf: bool = False
     stochastic_rounding: bool = True
+
+    # Observability: write a Chrome trace-event JSON of training spans
+    # to this path at exit (param twin of LGBM_TPU_TRACE; obs/trace.py,
+    # validated by tools/check_trace.py)
+    trace_output: str = ""
 
     # TPU-specific
     tpu_hist_dtype: str = "float32"

@@ -55,40 +55,75 @@ def train(params: Dict[str, Any], train_set: Dataset,
     callbacks_before.sort(key=lambda cb: getattr(cb, "order", 0))
     callbacks_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
-    booster.best_iteration = -1
-    for i in range(num_boost_round):
-        for cb in callbacks_before:
-            cb(callback_mod.CallbackEnv(
-                model=booster, params=params, iteration=i,
-                begin_iteration=0, end_iteration=num_boost_round,
-                evaluation_result_list=None))
-        should_stop = booster.update()
+    # attaching a telemetry callback opts the run into the metrics
+    # registry (like needs_eval opts into per-iteration evals); scoped —
+    # a telemetry run must not leave recording overhead enabled for
+    # later unrelated trains in the same process
+    from .obs.metrics import global_metrics
+    restore_telemetry = _scoped_telemetry_enable(callbacks)
 
-        evaluation_result_list = []
-        needs_eval = any(getattr(cb, "needs_eval", False)
-                         for cb in callbacks_after)
-        if (valid_sets or cfg.is_provide_training_metric) and \
-                (needs_eval or (cfg.metric_freq > 0
-                                and (i + 1) % cfg.metric_freq == 0)):
-            if is_valid_contain_train or cfg.is_provide_training_metric:
-                evaluation_result_list.extend(booster.eval_train(feval))
-            evaluation_result_list.extend(booster.eval_valid(feval))
-        try:
-            for cb in callbacks_after:
+    booster.best_iteration = -1
+    try:
+        for i in range(num_boost_round):
+            for cb in callbacks_before:
                 cb(callback_mod.CallbackEnv(
                     model=booster, params=params, iteration=i,
                     begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=evaluation_result_list))
-        except callback_mod.EarlyStopException as e:
-            booster.best_iteration = e.best_iteration + 1
-            for item in e.best_score:
-                booster.best_score.setdefault(item[0], {})[item[1]] = item[2]
-            break
-        if should_stop:
-            break
+                    evaluation_result_list=None))
+            should_stop = booster.update()
+            telemetry = (global_metrics.snapshot()
+                         if global_metrics.enabled else None)
+
+            evaluation_result_list = []
+            needs_eval = any(getattr(cb, "needs_eval", False)
+                             for cb in callbacks_after)
+            if (valid_sets or cfg.is_provide_training_metric) and \
+                    (needs_eval or (cfg.metric_freq > 0
+                                    and (i + 1) % cfg.metric_freq == 0)):
+                if is_valid_contain_train or cfg.is_provide_training_metric:
+                    evaluation_result_list.extend(booster.eval_train(feval))
+                evaluation_result_list.extend(booster.eval_valid(feval))
+            try:
+                for cb in callbacks_after:
+                    cb(callback_mod.CallbackEnv(
+                        model=booster, params=params, iteration=i,
+                        begin_iteration=0, end_iteration=num_boost_round,
+                        evaluation_result_list=evaluation_result_list,
+                        telemetry=telemetry))
+            except callback_mod.EarlyStopException as e:
+                booster.best_iteration = e.best_iteration + 1
+                for item in e.best_score:
+                    booster.best_score.setdefault(
+                        item[0], {})[item[1]] = item[2]
+                break
+            if should_stop:
+                break
+    finally:
+        restore_telemetry()
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
     return booster
+
+
+def _scoped_telemetry_enable(callbacks) -> Callable[[], None]:
+    """Enable the metrics registry when a telemetry callback is attached;
+    returns a restore function that puts the registry AND the tracer
+    (switched on by metrics.enable()) back to their prior state, so the
+    opt-in does not outlive the run it was requested for."""
+    from .obs.metrics import global_metrics
+    from .obs.trace import global_tracer
+    if not any(getattr(cb, "needs_telemetry", False)
+               for cb in (callbacks or [])):
+        return lambda: None
+    metrics_was, tracer_was = global_metrics.enabled, global_tracer.enabled
+    global_metrics.enable()
+
+    def restore() -> None:
+        if not metrics_was:
+            global_metrics.disable()
+            if not tracer_was:
+                global_tracer.disable()
+    return restore
 
 
 class CVBooster:
@@ -106,6 +141,28 @@ class CVBooster:
         def handler_function(*args, **kwargs):
             return [getattr(b, name)(*args, **kwargs) for b in self.boosters]
         return handler_function
+
+
+def _mean_fold_telemetry(fold_snaps):
+    """Cross-fold telemetry for one cv round: numeric metrics and phase
+    times averaged over the folds' per-iteration records (a single
+    fold's snapshot would misrepresent the round). None when empty."""
+    if not fold_snaps:
+        return None
+    out: Dict[str, Any] = {"folds": len(fold_snaps)}
+    keys = {k for s in fold_snaps for k in s if k != "phases"}
+    for k in keys:
+        vals = [s[k] for s in fold_snaps
+                if isinstance(s.get(k), (int, float))]
+        if vals:
+            out[k] = (fold_snaps[0][k] if k == "iteration"
+                      else float(np.mean(vals)))
+    pnames = {p for s in fold_snaps for p in s.get("phases", {})}
+    if pnames:
+        out["phases"] = {p: float(np.mean(
+            [s.get("phases", {}).get(p, 0.0) for s in fold_snaps]))
+            for p in pnames}
+    return out
 
 
 def _make_n_folds(full_data: Dataset, nfold: int, params, seed: int,
@@ -187,39 +244,51 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
             cfg.early_stopping_round, cfg.first_metric_only,
             verbose=cfg.verbosity > 0)
 
-    for i in range(num_boost_round):
-        all_results: Dict[str, List[float]] = {}
-        for bst in boosters:
-            bst.update()
-            res = bst.eval_valid(feval)
-            if eval_train_metric:
-                res = bst.eval_train(feval) + res
-            for name, metric, value, hib in res:
-                all_results.setdefault(f"{name} {metric}", []).append(value)
-                all_results.setdefault(f"__hib {name} {metric}", []).append(hib)
-        evaluation_result_list = []
-        for key, values in all_results.items():
-            if key.startswith("__hib"):
-                continue
-            hib = all_results[f"__hib {key}"][0]
-            mean, std = float(np.mean(values)), float(np.std(values))
-            results.setdefault(key + "-mean", []).append(mean)
-            results.setdefault(key + "-stdv", []).append(std)
-            evaluation_result_list.append(("cv_agg", key, mean, hib))
-        try:
-            env = callback_mod.CallbackEnv(
-                model=cvbooster, params=params, iteration=i,
-                begin_iteration=0, end_iteration=num_boost_round,
-                evaluation_result_list=evaluation_result_list)
-            if cb_early is not None:
-                cb_early(env)
-            for cb in (callbacks or []):
-                cb(env)
-        except callback_mod.EarlyStopException as e:
-            cvbooster.best_iteration = e.best_iteration + 1
-            for key in list(results.keys()):
-                results[key] = results[key][:cvbooster.best_iteration]
-            break
+    from .obs.metrics import global_metrics
+    restore_telemetry = _scoped_telemetry_enable(callbacks)
+
+    try:
+        for i in range(num_boost_round):
+            all_results: Dict[str, List[float]] = {}
+            fold_telemetry: List[Dict[str, Any]] = []
+            for bst in boosters:
+                bst.update()
+                if global_metrics.enabled and global_metrics.snapshot():
+                    fold_telemetry.append(global_metrics.snapshot())
+                res = bst.eval_valid(feval)
+                if eval_train_metric:
+                    res = bst.eval_train(feval) + res
+                for name, metric, value, hib in res:
+                    all_results.setdefault(
+                        f"{name} {metric}", []).append(value)
+                    all_results.setdefault(
+                        f"__hib {name} {metric}", []).append(hib)
+            evaluation_result_list = []
+            for key, values in all_results.items():
+                if key.startswith("__hib"):
+                    continue
+                hib = all_results[f"__hib {key}"][0]
+                mean, std = float(np.mean(values)), float(np.std(values))
+                results.setdefault(key + "-mean", []).append(mean)
+                results.setdefault(key + "-stdv", []).append(std)
+                evaluation_result_list.append(("cv_agg", key, mean, hib))
+            try:
+                env = callback_mod.CallbackEnv(
+                    model=cvbooster, params=params, iteration=i,
+                    begin_iteration=0, end_iteration=num_boost_round,
+                    evaluation_result_list=evaluation_result_list,
+                    telemetry=_mean_fold_telemetry(fold_telemetry))
+                if cb_early is not None:
+                    cb_early(env)
+                for cb in (callbacks or []):
+                    cb(env)
+            except callback_mod.EarlyStopException as e:
+                cvbooster.best_iteration = e.best_iteration + 1
+                for key in list(results.keys()):
+                    results[key] = results[key][:cvbooster.best_iteration]
+                break
+    finally:
+        restore_telemetry()
 
     if return_cvbooster:
         results["cvbooster"] = cvbooster

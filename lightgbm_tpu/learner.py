@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from .obs.metrics import global_metrics
 from .ops import histogram as hist_ops
 from .ops import partition as part_ops
 from .ops import split as split_ops
@@ -195,7 +196,9 @@ def _sharded_pallas_build(shard_mesh, *, max_bins: int, dtype,
         hl = hist_ops.build_histogram(
             b_l, g_l, h_l, m_l, max_bins=max_bins, dtype=dtype,
             row_chunk=row_chunk, impl="pallas", precision=precision)
-        return lax.psum(hl, axis)
+        out = lax.psum(hl, axis)
+        global_metrics.note_collective("psum", out.size * out.dtype.itemsize)
+        return out
 
     fn = jax.shard_map(local, mesh=shard_mesh,
                        in_specs=(P(None, axis), P(axis), P(axis), P(axis)),
@@ -235,7 +238,9 @@ def _sharded_pallas_multi(shard_mesh, *, max_bins: int,
         else:
             h = hist_pallas_multi(b_l, ghT_l, rl_l, ids, max_bins=max_bins,
                                   num_slots=ids.shape[0], precise=precision)
-        return lax.psum(h, axis)
+        out = lax.psum(h, axis)
+        global_metrics.note_collective("psum", out.size * out.dtype.itemsize)
+        return out
 
     fn = jax.shard_map(local, mesh=shard_mesh,
                        in_specs=(P(None, axis), P(axis, None), P(axis), P()),

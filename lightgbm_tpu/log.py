@@ -24,6 +24,7 @@ _level = INFO
 _logger: Optional[Any] = None
 _info_method = "info"
 _warning_method = "warning"
+_debug_method: Optional[str] = None
 
 
 def set_verbosity(verbosity: int) -> None:
@@ -41,24 +42,40 @@ def set_verbosity(verbosity: int) -> None:
 
 
 def register_logger(logger: Any, info_method_name: str = "info",
-                    warning_method_name: str = "warning") -> None:
+                    warning_method_name: str = "warning",
+                    debug_method_name: Optional[str] = None) -> None:
     """Replace the default print-based output with a custom logger
-    (ref: python-package/lightgbm/basic.py register_logger)."""
+    (ref: python-package/lightgbm/basic.py register_logger).
+
+    ``debug_method_name`` optionally routes Debug-level messages to a
+    dedicated method; when omitted, Debug falls back to the info method
+    (but still through the registered logger — Debug never bypasses it).
+    """
     for name in (info_method_name, warning_method_name):
         if not callable(getattr(logger, name, None)):
             raise TypeError(
                 f"Logger must provide a callable {name}() method")
-    global _logger, _info_method, _warning_method
+    if debug_method_name is not None and \
+            not callable(getattr(logger, debug_method_name, None)):
+        raise TypeError(
+            f"Logger must provide a callable {debug_method_name}() method")
+    global _logger, _info_method, _warning_method, _debug_method
     _logger = logger
     _info_method = info_method_name
     _warning_method = warning_method_name
+    _debug_method = debug_method_name
 
 
 def _emit(level: int, msg: str, force: bool = False) -> None:
     if level > _level and not force:
         return
     if _logger is not None:
-        meth = _warning_method if level <= WARNING else _info_method
+        if level <= WARNING:
+            meth = _warning_method
+        elif level >= DEBUG and _debug_method is not None:
+            meth = _debug_method
+        else:
+            meth = _info_method
         getattr(_logger, meth)(msg)
     else:
         print(f"[LightGBM-TPU] [{_LEVEL_NAMES[level]}] {msg}", flush=True)

@@ -1,0 +1,23 @@
+"""Training telemetry subsystem.
+
+The TPU-native expansion of the reference's ``USE_TIMETAG`` phase
+timers (ref: Common::Timer / FunctionTimer, include/LightGBM/utils/
+common.h:980,1044; global_timer dump at src/boosting/gbdt.cpp:29):
+
+- ``obs.trace``   — nested named spans with parent/child self-time
+  attribution, exportable as Chrome trace-event JSON
+  (``LGBM_TPU_TRACE=/path.json`` or the ``trace_output`` param) and as
+  an aggregated summary dict.
+- ``obs.metrics`` — per-iteration metrics registry: phase times,
+  grad/hess norms, leaves grown, split-gain stats, JIT recompilation
+  counts, device memory, collective traffic.
+
+Both are disabled by default and their hot-path guards are single
+attribute checks — training with telemetry off records nothing and
+allocates nothing per span/observation.
+"""
+
+from .trace import Tracer, global_tracer  # noqa: F401
+from .metrics import MetricsRegistry, global_metrics  # noqa: F401
+
+__all__ = ["Tracer", "global_tracer", "MetricsRegistry", "global_metrics"]

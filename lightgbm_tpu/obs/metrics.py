@@ -1,0 +1,182 @@
+"""Per-iteration training metrics registry.
+
+Collects, per boosting iteration: wall time, per-phase times (fed from
+``obs.trace`` span self-times), gradient/hessian norms and clip counts,
+leaves grown, best-split gain stats, JIT recompilation counts, device
+memory stats, and collective traffic for the data-/voting-parallel
+paths (ref: the reference attributes wins via exactly such per-phase
+breakdowns — Common::Timer dumps, and the per-phase tables in
+arXiv:1806.11248 / arXiv:2005.09148).
+
+Two cost regimes, by design:
+
+- **Disabled (default):** every per-iteration entry point
+  (``begin_iteration`` / ``observe`` / ``inc`` / ``end_iteration``)
+  returns after a single attribute check — nothing is recorded,
+  nothing is allocated.
+- **Trace-time counters** (``note_trace`` / ``note_collective``) are
+  always live: they execute only while jax traces a program (i.e. at
+  compile time, never per iteration), so JIT recompilations are
+  detectable even with telemetry off.
+
+Enabled via ``LGBM_TPU_TELEMETRY=1``, ``enable()``, or by attaching
+the ``callback.log_telemetry`` / ``callback.record_telemetry``
+callbacks to ``train``.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+
+class MetricsRegistry:
+    def __init__(self) -> None:
+        self.enabled = os.environ.get(
+            "LGBM_TPU_TELEMETRY", "") not in ("", "0")
+        self.history: List[Dict[str, Any]] = []
+        self._current: Optional[Dict[str, Any]] = None
+        self._iter_t0 = 0.0
+        # trace-time counters (always live; see module docstring)
+        self.trace_counts: Dict[str, int] = {}
+        self.collective_calls = 0
+        self.collective_bytes = 0
+        # static run facts (mesh size, learner kind, ...), set once at
+        # setup — not per-iteration, so always-on is free
+        self.meta: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------------
+    def enable(self) -> None:
+        self.enabled = True
+        # phase times come from span self-times; the tracer must run for
+        # the sink to fire (summary-only: no exit print, no export)
+        from .trace import global_tracer
+        global_tracer.enable()
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        self.history.clear()
+        self._current = None
+        self.trace_counts.clear()
+        self.collective_calls = 0
+        self.collective_bytes = 0
+        self.meta.clear()
+
+    def set_meta(self, key: str, value) -> None:
+        self.meta[key] = value
+
+    # ------------------------------------------------------------------
+    # per-iteration lifecycle (called by GBDT.train_one_iter)
+    def begin_iteration(self, iteration: int) -> None:
+        if not self.enabled:
+            return
+        self._current = {"iteration": iteration, "phases": {}}
+        self._iter_t0 = time.perf_counter()
+
+    def end_iteration(self) -> None:
+        cur = self._current
+        if not self.enabled or cur is None:
+            return
+        cur["iteration_seconds"] = time.perf_counter() - self._iter_t0
+        mem = self.device_memory_stats()
+        if mem is not None:
+            cur["device_bytes_in_use"] = mem.get("bytes_in_use")
+            cur["device_peak_bytes_in_use"] = mem.get("peak_bytes_in_use")
+        cur["collective_calls_total"] = self.collective_calls
+        cur["collective_bytes_total"] = self.collective_bytes
+        self._current = None
+        self.history.append(cur)
+
+    def observe(self, name: str, value) -> None:
+        # local ref: another thread's end_iteration may null _current
+        # between the check and the write (predict during train)
+        cur = self._current
+        if not self.enabled or cur is None:
+            return
+        cur[name] = value
+
+    def inc(self, name: str, n: int = 1) -> None:
+        cur = self._current
+        if not self.enabled or cur is None:
+            return
+        cur[name] = cur.get(name, 0) + n
+
+    def phase_sink(self, name: str, dur_s: float, self_s: float) -> None:
+        """Span sink (registered on the global tracer): accumulate span
+        SELF time into the open iteration's phase table — self time sums
+        to wall time without double-counting nested spans."""
+        cur = self._current
+        if not self.enabled or cur is None:
+            return
+        phases = cur["phases"]
+        phases[name] = phases.get(name, 0.0) + self_s
+
+    # ------------------------------------------------------------------
+    # trace-time counters (executed while jax traces, i.e. per compile)
+    def note_trace(self, tag: str, top_level: bool = False) -> None:
+        """Mark one Python trace of `tag`'s function body. The
+        per-tag counter advances once per body execution under a trace —
+        for a top-level jitted program that is exactly once per
+        (re)compile; an op called N times inside one program advances
+        its tag N times per compile (a call-site count, still zero when
+        the program cache hits). Only ``top_level=True`` calls (the
+        wrap_traced program wrappers) feed the per-iteration
+        ``jit_recompiles`` metric, so it counts program recompiles, not
+        inner call sites."""
+        self.trace_counts[tag] = self.trace_counts.get(tag, 0) + 1
+        if top_level and self.enabled:
+            cur = self._current
+            if cur is not None:
+                cur["jit_recompiles"] = cur.get("jit_recompiles", 0) + 1
+
+    def wrap_traced(self, tag: str, fn):
+        """fn -> fn that notes a trace each time jax traces it; jit the
+        RESULT (``jax.jit(registry.wrap_traced("tag", f))``)."""
+        def wrapped(*args, **kwargs):
+            self.note_trace(tag, top_level=True)
+            return fn(*args, **kwargs)
+        wrapped.__name__ = getattr(fn, "__name__", tag)
+        return wrapped
+
+    def recompiles(self, tag: Optional[str] = None) -> int:
+        if tag is not None:
+            return self.trace_counts.get(tag, 0)
+        return sum(self.trace_counts.values())
+
+    def note_collective(self, op: str, nbytes: int) -> None:
+        """Account one collective (psum/all_gather) emitted into a traced
+        program. Trace-time: counts collectives per compiled program, the
+        static analog of the reference's per-split network byte counts
+        (ref: data_parallel_tree_learner.cpp HistogramSumReducer)."""
+        self.collective_calls += 1
+        self.collective_bytes += int(nbytes)
+        self.trace_counts[f"collective/{op}"] = \
+            self.trace_counts.get(f"collective/{op}", 0) + 1
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def device_memory_stats() -> Optional[Dict[str, Any]]:
+        """device.memory_stats() of the default device, when the backend
+        provides it (TPU/GPU do; CPU returns None)."""
+        try:
+            import jax
+            stats = jax.local_devices()[0].memory_stats()
+            return dict(stats) if stats else None
+        except Exception:
+            return None
+
+    def snapshot(self) -> Optional[Dict[str, Any]]:
+        """The most recent completed iteration's metrics dict."""
+        return self.history[-1] if self.history else None
+
+
+global_metrics = MetricsRegistry()
+
+# phase-time feed: span self-times land in the open iteration's table
+from .trace import global_tracer as _gt  # noqa: E402
+_gt.add_sink(global_metrics.phase_sink)
+if global_metrics.enabled:
+    _gt.enable()

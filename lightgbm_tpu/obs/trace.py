@@ -1,0 +1,258 @@
+"""Span tracer: nested named spans with self-time attribution.
+
+The structured successor of the flat wall-clock ``timer.Timer``
+(ref: Common::Timer / FunctionTimer, include/LightGBM/utils/common.h:
+980,1044). Spans nest via a real stack, so a parent's *self* time —
+total minus time spent inside child spans — is attributable, the way
+the reference's ``FunctionTimer`` frames nest inside each other.
+
+jax device work is asynchronous: a span that must charge dispatched
+device work to itself passes ``block=`` a pytree of jax arrays (or a
+zero-arg callable returning one) which is waited on before the clock
+stops.
+
+Export formats:
+- ``summary()``   — aggregated {name: {seconds, self_seconds, count}}.
+- ``export_chrome(path)`` — Chrome trace-event JSON (load in
+  chrome://tracing or Perfetto); validated by ``tools/check_trace.py``.
+
+Enabling:
+- ``LGBM_TPU_TRACE=/path.json`` in the environment (or the
+  ``trace_output`` train param) enables the tracer and writes the
+  Chrome trace at interpreter exit.
+- ``LGBM_TPU_TIMETAG=1`` (or ``enable()``) prints the aggregated
+  summary at exit, exactly like the reference's atexit dump.
+
+When disabled, ``span()`` returns a shared no-op context manager —
+no allocation, one attribute check.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+
+class _NullSpan:
+    """Shared do-nothing context manager for the disabled fast path."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanFrame:
+    """One live span (context manager); exists only while enabled."""
+    __slots__ = ("tracer", "name", "block", "t0", "child_ns")
+
+    def __init__(self, tracer: "Tracer", name: str, block) -> None:
+        self.tracer = tracer
+        self.name = name
+        self.block = block
+        self.child_ns = 0
+
+    def __enter__(self) -> "_SpanFrame":
+        self.tracer._stack.append(self)
+        self.t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb) -> bool:
+        if self.block is not None and exc_type is None:
+            # skip the device wait when the body raised (the timing is
+            # garbage then, and block= lambdas commonly reference names
+            # bound inside the span body); never let telemetry mask the
+            # user's exception
+            try:
+                import jax
+                b = self.block
+                jax.block_until_ready(b() if callable(b) else b)
+            except Exception:
+                pass
+        t1 = time.perf_counter_ns()
+        tracer = self.tracer
+        stack = tracer._stack
+        # tolerate a mispaired exit (exception unwound past frames)
+        while stack and stack[-1] is not self:
+            stack.pop()
+        if stack:
+            stack.pop()
+        dur = t1 - self.t0
+        if stack:
+            stack[-1].child_ns += dur
+        tracer._record(self.name, self.t0, dur, dur - self.child_ns,
+                       len(stack))
+        return False
+
+
+class Tracer:
+    """Nested named spans, aggregation, and Chrome trace export."""
+
+    # raw-event cap: aggregation (summary/report) is unbounded either
+    # way; past the cap only the per-span Chrome events stop growing
+    # (~50 B each -> ~50 MB ceiling), with the drop count reported in
+    # the export. Keeps week-long LGBM_TPU_TIMETAG runs flat in memory.
+    MAX_EVENTS = 1_000_000
+
+    def __init__(self) -> None:
+        self.enabled = False
+        self.print_summary_at_exit = False
+        self.trace_path: Optional[str] = None
+        self._tls = threading.local()  # per-thread span stack
+        self._lock = threading.Lock()  # guards _events/_agg/sinks
+        self._dropped_events = 0
+        # completed spans: (name, start_ns, dur_ns, self_ns, depth, tid)
+        self._events: List[tuple] = []
+        self._agg: Dict[str, List[float]] = {}  # name -> [total, self, count]
+        self._sinks: List[Any] = []  # callables(name, dur_s, self_s)
+        self._exported = False
+        self._printed = False
+
+        env_path = os.environ.get("LGBM_TPU_TRACE", "")
+        if env_path:
+            self.enable(path=env_path)
+        if os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0"):
+            self.enable(print_at_exit=True)
+
+    @property
+    def _stack(self) -> List["_SpanFrame"]:
+        """This thread's open-span stack — spans on one thread must never
+        pop frames opened by another (e.g. a predict worker thread while
+        the main thread trains)."""
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    # ------------------------------------------------------------------
+    def enable(self, path: Optional[str] = None,
+               print_at_exit: bool = False) -> None:
+        self.enabled = True
+        if path:
+            self.trace_path = path
+        if print_at_exit:
+            self.print_summary_at_exit = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        self._stack.clear()
+        with self._lock:
+            self._events.clear()
+            self._agg.clear()
+            self._dropped_events = 0
+        self._exported = False
+        self._printed = False
+
+    def add_sink(self, sink) -> None:
+        """Register a callable(name, dur_seconds, self_seconds) invoked on
+        every completed span (the metrics registry hooks phase times here)."""
+        if sink not in self._sinks:
+            self._sinks.append(sink)
+
+    # ------------------------------------------------------------------
+    def span(self, name: str, block: Optional[Any] = None):
+        """Time a nested phase. Disabled mode returns a shared no-op
+        context manager (no allocation)."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _SpanFrame(self, name, block)
+
+    def _record(self, name: str, start_ns: int, dur_ns: int, self_ns: int,
+                depth: int) -> None:
+        with self._lock:
+            if len(self._events) < self.MAX_EVENTS:
+                self._events.append((name, start_ns, dur_ns, self_ns,
+                                     depth, threading.get_ident()))
+            else:
+                self._dropped_events += 1
+            agg = self._agg.get(name)
+            if agg is None:
+                agg = self._agg[name] = [0.0, 0.0, 0]
+            agg[0] += dur_ns * 1e-9
+            agg[1] += self_ns * 1e-9
+            agg[2] += 1
+        for sink in self._sinks:
+            sink(name, dur_ns * 1e-9, self_ns * 1e-9)
+
+    # ------------------------------------------------------------------
+    def summary(self) -> Dict[str, Dict[str, float]]:
+        """Aggregated per-phase totals, reference-dump shaped."""
+        with self._lock:
+            items = [(n, list(a)) for n, a in self._agg.items()]
+        return {name: {"seconds": agg[0], "self_seconds": agg[1],
+                       "count": agg[2]}
+                for name, agg in sorted(items)}
+
+    def report(self) -> str:
+        s = self.summary()
+        lines = ["LightGBM-TPU phase timers:"]
+        for name in sorted(s, key=lambda n: s[n]["seconds"], reverse=True):
+            lines.append(f"  {name:32s} {s[name]['seconds']:10.3f}s "
+                         f"(self {s[name]['self_seconds']:8.3f}s) "
+                         f"x{int(s[name]['count'])}")
+        return "\n".join(lines)
+
+    def chrome_events(self) -> List[Dict[str, Any]]:
+        """Completed spans as Chrome trace-event dicts (phase "X",
+        microsecond timestamps), sorted by start time."""
+        pid = os.getpid()
+        with self._lock:
+            snapshot = list(self._events)
+        events = []
+        for name, start_ns, dur_ns, self_ns, depth, tid in sorted(
+                snapshot, key=lambda e: e[1]):
+            events.append({
+                "name": name,
+                "ph": "X",
+                "ts": start_ns / 1000.0,
+                "dur": dur_ns / 1000.0,
+                "pid": pid,
+                "tid": tid,
+                "args": {"self_us": self_ns / 1000.0, "depth": depth},
+            })
+        return events
+
+    def export_chrome(self, path: str) -> None:
+        doc = {
+            "traceEvents": self.chrome_events(),
+            "displayTimeUnit": "ms",
+            "otherData": {"producer": "lightgbm_tpu.obs.trace",
+                          "dropped_events": self._dropped_events},
+        }
+        with open(path, "w") as fh:
+            json.dump(doc, fh)
+
+    def print_summary_once(self) -> None:
+        """Print the aggregated report (at most once) — the USE_TIMETAG
+        dump. Does NOT export the trace file; that stays an exit-time
+        (or explicit export_chrome) action so a mid-run summary print
+        cannot truncate the trace."""
+        if self.print_summary_at_exit and self._agg and not self._printed:
+            self._printed = True
+            print(self.report(), flush=True)
+
+    # ------------------------------------------------------------------
+    def _at_exit(self) -> None:
+        if self.trace_path and self._events and not self._exported:
+            self._exported = True
+            try:
+                self.export_chrome(self.trace_path)
+            except OSError as exc:
+                print(f"[LightGBM-TPU] trace export to "
+                      f"{self.trace_path} failed: {exc}", flush=True)
+        self.print_summary_once()
+
+
+global_tracer = Tracer()
+atexit.register(global_tracer._at_exit)

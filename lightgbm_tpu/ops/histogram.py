@@ -19,6 +19,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..obs.metrics import global_metrics
+
 GRAD, HESS, COUNT = 0, 1, 2
 NUM_HIST_CHANNELS = 3
 
@@ -78,6 +80,9 @@ def build_histogram(bins_fm: jax.Array, grad: jax.Array, hess: jax.Array,
     Returns:
       ``[F, B, 3]`` histogram in `dtype`.
     """
+    # trace-time only: counts histogram-pass (re)compilations, never
+    # executes per iteration (obs.metrics module docstring)
+    global_metrics.note_trace("ops/histogram")
     if impl == "pallas":
         from .pallas_histogram import hist_pallas
         gh3 = jnp.stack([grad * mask, hess * mask, mask]).astype(jnp.float32)
@@ -119,6 +124,7 @@ def build_histogram_sparse(sb, grad: jax.Array, hess: jax.Array,
     of every feature receives (leaf totals - explicit sums). Work scales
     with nnz instead of N*F*B — the scaling axis wide-sparse data needs.
     """
+    global_metrics.note_trace("ops/histogram_sparse")
     gh = jnp.stack([grad * mask, hess * mask, mask], axis=-1).astype(dtype)
     flat = sb.coo_feat * max_bins + sb.coo_bin
     hist = jax.ops.segment_sum(gh[sb.coo_row], flat,
@@ -136,6 +142,7 @@ def hist_multi_sparse(sb, ghT: jax.Array, row_leaf: jax.Array,
     leaf's slot (or a dropped overflow slot), one segment-sum covers all
     slots' explicit entries, and each slot's implicit-zero mass is
     recovered from its own totals. Returns [S, F, B, 3]."""
+    global_metrics.note_trace("ops/histogram_multi_sparse")
     eq = row_leaf[:, None] == leaf_ids[None, :]       # [N, S]
     slot = jnp.where(jnp.any(eq, axis=1),
                      jnp.argmax(eq, axis=1), num_slots)

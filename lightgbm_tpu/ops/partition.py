@@ -15,6 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from .split import MISSING_NAN
+from ..obs.metrics import global_metrics
 
 
 class SparseBins(NamedTuple):
@@ -108,6 +109,7 @@ def apply_wave_splits(row_leaf: jax.Array, bins_fm: jax.Array,
     analog of the multi-leaf histogram kernel and the main HBM saving
     of waved growth beyond the histogram batching itself.
     """
+    global_metrics.note_trace("ops/partition_wave")
     w_count = leaf_ids.shape[0]
     L = num_leaves
     lids = jnp.where(valid, leaf_ids, L)
@@ -140,6 +142,7 @@ def apply_split(row_leaf: jax.Array, bins_fm: jax.Array,
     `cat_mask` ([B] bool — the device analog of the reference's category
     bitset, tree.h:375) go left. No-op when `valid` is False.
     """
+    global_metrics.note_trace("ops/partition")
     fbins = feature_bins(bins_fm, feature, bundle,
                          num_data=row_leaf.shape[0])  # [N]
     nan_bin = num_bins[feature] - 1

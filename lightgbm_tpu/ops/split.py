@@ -21,6 +21,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .histogram import GRAD, HESS, COUNT
+from ..obs.metrics import global_metrics
 
 MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
 K_MIN_SCORE = -1e30
@@ -486,6 +487,8 @@ def find_best_split(hist: jax.Array,
     leaf's depth (monotone penalty); rand_bins: optional [F] extra-trees
     random thresholds. Returns scalar SplitInfo.
     """
+    # trace-time only: counts split-search (re)compilations
+    global_metrics.note_trace("ops/split_search")
     if parent_output is None:
         parent_output = jnp.float32(0.0)
     if min_bound is None:

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 from ..boosting import DART, GBDT, RF
 from ..config import Config
 from ..dataset import BinnedDataset
+from ..obs.metrics import global_metrics
+from ..obs.trace import global_tracer
 from ..objectives import ObjectiveFunction
 from . import mesh as mesh_lib
 
@@ -38,9 +40,17 @@ class _DataParallelMixin:
     """Shards row-indexed device state over the mesh data axis."""
 
     def _setup_sharding(self, num_shards: int):
+        with global_tracer.span("parallel/setup_sharding"):
+            self._setup_sharding_inner(num_shards)
+        global_metrics.set_meta("mesh_size", int(self.mesh.size))
+        global_metrics.set_meta("tree_learner",
+                                str(self.config.tree_learner))
+
+    def _setup_sharding_inner(self, num_shards: int):
         self.mesh = mesh_lib.get_mesh(num_shards)
         if jax.process_count() > 1:
-            self._setup_multihost()
+            with global_tracer.span("parallel/setup_multihost"):
+                self._setup_multihost()
             return
         if self.num_data % max(self.mesh.size, 1) != 0:
             # NamedSharding needs equal shards. Row tensors stay

@@ -26,6 +26,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..learner import TreeArrays, _LeafSplits, _store_split
+from ..obs.metrics import global_metrics
 from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
 from ..ops import split as split_ops
@@ -34,6 +35,14 @@ from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams,
                          find_best_split, leaf_output, per_feature_best_gain,
                          propagate_monotone_bounds)
 from . import mesh as mesh_lib
+
+
+def _note_collective(op: str, arr: jax.Array) -> None:
+    """Trace-time collective accounting: runs once per compiled program
+    (shapes are static under the trace), feeding obs.metrics the per-
+    program ICI byte/call profile — the static analog of the reference's
+    per-split network counters (network.cpp Allreduce sizes)."""
+    global_metrics.note_collective(op, arr.size * arr.dtype.itemsize)
 
 
 def _local_leaf_sums(local_hist: jax.Array):
@@ -64,17 +73,20 @@ def _vote_and_reduce(local_hist, pg, ph, pc, parent_out, min_b, max_b,
     # --- vote: each shard proposes its top-k features
     _, prop = lax.top_k(local_gain, top_k)                    # [k]
     all_props = lax.all_gather(prop, axis_name).reshape(-1)    # [W*k]
+    _note_collective("all_gather", all_props)
     votes = jnp.zeros((num_features,), jnp.float32).at[all_props].add(1.0)
     # tie-break votes by the summed local gains (deterministic; the
     # reference breaks ties arbitrarily by machine order)
     gain_sum = lax.psum(jnp.maximum(local_gain, K_MIN_SCORE * 1e-3),
                         axis_name)
+    _note_collective("psum", gain_sum)
     norm = jnp.max(jnp.abs(gain_sum)) + 1.0
     _, cand = lax.top_k(votes + gain_sum / (norm * 4.0), num_candidates)
     cand = cand.astype(jnp.int32)                              # [C]
 
     # --- reduce only the candidates' histograms (ref: :396)
     cand_hist = lax.psum(local_hist[cand], axis_name)          # [C, B, 3]
+    _note_collective("psum", cand_hist)
     cand_meta = jax.tree_util.tree_map(lambda a: a[cand], meta)
     info = find_best_split(cand_hist, pg, ph, pc, cand_meta, hp,
                            feature_mask[cand], parent_out, min_b, max_b,
@@ -119,6 +131,9 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
     root_g = lax.psum(jnp.sum(grad * sample_mask, dtype=f32), axis_name)
     root_h = lax.psum(jnp.sum(hess * sample_mask, dtype=f32), axis_name)
     root_c = lax.psum(jnp.sum(sample_mask, dtype=f32), axis_name)
+    _note_collective("psum", root_g)
+    _note_collective("psum", root_h)
+    _note_collective("psum", root_c)
     root_out = leaf_output(root_g, root_h, hp)
     neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
     root_split = vote(root_hist, root_g, root_h, root_c, root_out,

@@ -2,73 +2,56 @@
 include/LightGBM/utils/common.h:980,1044; global_timer printed at exit
 under USE_TIMETAG, src/boosting/gbdt.cpp:29).
 
-Enabled by ``LGBM_TPU_TIMETAG=1`` in the environment or
-``global_timer.enable()``; when enabled, a summary prints at interpreter
-exit exactly like the reference's atexit dump. ``timed`` phases nest via
-a stack so self-time is attributable. jax device work is asynchronous —
-phases that must charge device time to themselves should pass
-``block=`` the arrays to wait on.
+Compatibility facade over ``obs.trace.Tracer`` — the structured span
+tracer that now owns all phase timing. ``timed`` phases nest via the
+tracer's span stack, so self-time is attributable (``summary()``
+exposes it as ``self_seconds``). Enabled by ``LGBM_TPU_TIMETAG=1`` in
+the environment or ``global_timer.enable()``; when enabled, a summary
+prints at interpreter exit exactly like the reference's atexit dump.
+jax device work is asynchronous — phases that must charge device time
+to themselves should pass ``block=`` the arrays to wait on.
 """
 
 from __future__ import annotations
 
-import atexit
-import os
-import time
-from collections import defaultdict
-from contextlib import contextmanager
 from typing import Any, Dict, Optional
+
+from .obs.trace import Tracer, global_tracer
 
 
 class Timer:
-    def __init__(self) -> None:
-        self.enabled = os.environ.get("LGBM_TPU_TIMETAG", "") not in ("", "0")
-        self._total: Dict[str, float] = defaultdict(float)
-        self._count: Dict[str, int] = defaultdict(int)
-        self._printed = False
+    """Thin facade: every method delegates to the span tracer."""
+
+    def __init__(self, tracer: Optional[Tracer] = None) -> None:
+        self._tracer = tracer if tracer is not None else global_tracer
+
+    @property
+    def enabled(self) -> bool:
+        return self._tracer.enabled
 
     def enable(self) -> None:
-        self.enabled = True
+        self._tracer.enable(print_at_exit=True)
 
     def reset(self) -> None:
-        self._total.clear()
-        self._count.clear()
+        self._tracer.reset()
 
-    @contextmanager
     def timed(self, name: str, block: Optional[Any] = None):
-        """Time a phase. ``block`` (optional pytree of jax arrays) is
-        waited on before the clock stops, so asynchronously-dispatched
-        device work is charged to the phase that launched it."""
-        if not self.enabled:
-            yield
-            return
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            if block is not None:
-                import jax
-                jax.block_until_ready(block() if callable(block) else block)
-            self._total[name] += time.perf_counter() - t0
-            self._count[name] += 1
+        """Time a phase (a tracer span). ``block`` (optional pytree of
+        jax arrays, or a zero-arg callable returning one) is waited on
+        before the clock stops, so asynchronously-dispatched device work
+        is charged to the phase that launched it."""
+        return self._tracer.span(name, block=block)
 
     def summary(self) -> Dict[str, Dict[str, float]]:
-        return {name: {"seconds": self._total[name],
-                       "count": self._count[name]}
-                for name in sorted(self._total)}
+        return self._tracer.summary()
 
     def report(self) -> str:
-        lines = ["LightGBM-TPU phase timers:"]
-        for name in sorted(self._total, key=self._total.get, reverse=True):
-            lines.append(f"  {name:32s} {self._total[name]:10.3f}s "
-                         f"x{self._count[name]}")
-        return "\n".join(lines)
+        return self._tracer.report()
 
     def print_at_exit(self) -> None:
-        if self.enabled and self._total and not self._printed:
-            self._printed = True
-            print(self.report(), flush=True)
+        # kept for API compat: print-only, like the pre-facade Timer (a
+        # mid-run call must not trigger the trace export and truncate it)
+        self._tracer.print_summary_once()
 
 
 global_timer = Timer()
-atexit.register(global_timer.print_at_exit)

@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Validate a Chrome trace-event JSON file produced by
+``lightgbm_tpu.obs.trace`` (``LGBM_TPU_TRACE=/path.json`` or the
+``trace_output`` param).
+
+Checks, in order:
+  1. the file is valid JSON;
+  2. it is either a bare event list or an object with a
+     ``traceEvents`` list (both forms are valid Chrome traces);
+  3. every event has the required fields with the right types
+     (``name`` str, ``ph`` str, and for complete events ``ph == "X"``:
+     numeric non-negative ``ts`` and ``dur``);
+  4. per (pid, tid) track, ``ts`` is monotonically non-decreasing in
+     file order (the exporter sorts by start time; a violation means a
+     corrupted or hand-edited trace).
+
+Usage:  python tools/check_trace.py TRACE.json
+Exit 0 when the trace is valid; 1 with a diagnostic otherwise — so a
+CI or bench run can assert trace integrity with one command.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Any, List, Tuple
+
+
+def check_trace(path: str) -> Tuple[bool, str]:
+    """-> (ok, message). Importable for tests; no side effects."""
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except OSError as exc:
+        return False, f"cannot read {path}: {exc}"
+    except json.JSONDecodeError as exc:
+        return False, f"{path} is not valid JSON: {exc}"
+
+    if isinstance(doc, list):
+        events: List[Any] = doc
+    elif isinstance(doc, dict):
+        events = doc.get("traceEvents")
+        if not isinstance(events, list):
+            return False, "top-level object has no 'traceEvents' list"
+    else:
+        return False, f"unexpected top-level JSON type {type(doc).__name__}"
+
+    last_ts = {}  # (pid, tid) -> ts
+    n_complete = 0
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            return False, f"event {i} is not an object"
+        name, ph = ev.get("name"), ev.get("ph")
+        if not isinstance(name, str) or not name:
+            return False, f"event {i} has no string 'name'"
+        if not isinstance(ph, str) or not ph:
+            return False, f"event {i} ({name!r}) has no string 'ph'"
+        if ph != "X":
+            continue  # metadata/counter events need no ts ordering
+        n_complete += 1
+        ts, dur = ev.get("ts"), ev.get("dur")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            return False, f"event {i} ({name!r}) has invalid ts={ts!r}"
+        if not isinstance(dur, (int, float)) or dur < 0:
+            return False, f"event {i} ({name!r}) has invalid dur={dur!r}"
+        track = (ev.get("pid"), ev.get("tid"))
+        prev = last_ts.get(track)
+        if prev is not None and ts < prev:
+            return False, (f"event {i} ({name!r}) breaks ts monotonicity "
+                           f"on track {track}: {ts} < {prev}")
+        last_ts[track] = ts
+    return True, f"ok: {n_complete} complete spans on {len(last_ts)} track(s)"
+
+
+def main(argv: List[str]) -> int:
+    if len(argv) != 2:
+        print("usage: python tools/check_trace.py TRACE.json",
+              file=sys.stderr)
+        return 2
+    ok, msg = check_trace(argv[1])
+    print(msg, file=sys.stdout if ok else sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv))
