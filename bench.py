@@ -1,7 +1,15 @@
-"""Benchmark: boosting iterations/sec on Higgs-shaped data.
+"""Benchmark: boosting iterations/sec on Higgs-shaped data — and, with
+`--predict`, serving rows/sec through the tree-parallel inference
+engine (ops/predict.py) vs the pre-engine per-tree-scan path.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+`--predict` emits metric `predict_rows_per_sec` on the serving bench
+shape (T=100 trees, 255 leaves, 28 features); `vs_baseline` is the
+speedup over the per-tree `lax.scan` traversal the engine replaced
+(measured in the same run, same chunking), so the serving trajectory
+gets its own BENCH series with a self-contained anchor.
 
 Baseline: the reference CPU result on Higgs-10.5M — 500 iterations in
 130.094 s => 3.843 iters/sec (docs/Experiments.rst:113; see BASELINE.md).
@@ -37,6 +45,12 @@ import numpy as np
 
 BASELINE_IPS = 500.0 / 130.094  # reference CPU Higgs-10.5M iters/sec
 RELAY_PORTS = (8082, 8083, 8087)
+
+
+def _bench_mode() -> str:
+    if "--predict" in sys.argv or os.environ.get("BENCH_MODE") == "predict":
+        return "predict"
+    return "train"
 
 # XLA/absl startup spam (machine-feature warnings, duplicate-registration
 # errors) that would otherwise pollute the stderr tail captured into
@@ -80,6 +94,7 @@ def _run_child(rows: int, platform: str, timeout: float,
     else:
         env = dict(os.environ)
     env["BENCH_CHILD"] = "1"
+    env["BENCH_MODE"] = _bench_mode()
     env["BENCH_ROWS"] = str(rows)
     env["BENCH_OUT"] = out_path
     # child stderr goes through a file so XLA startup spam can be
@@ -131,7 +146,9 @@ def _replay_child_stderr(path: str) -> None:
 
 
 def main():
-    requested = int(os.environ.get("BENCH_ROWS", 10_500_000))
+    predict_mode = _bench_mode() == "predict"
+    default_rows = 8_000_000 if predict_mode else 10_500_000
+    requested = int(os.environ.get("BENCH_ROWS", default_rows))
     budget = float(os.environ.get("BENCH_TRY_TIMEOUT", 1200))
 
     attempts = []
@@ -150,8 +167,10 @@ def main():
     # CPU fallback: tiny shard so the 1-core host finishes (measured:
     # ~90s compile + ~11s/iter at 20k rows, 255 leaves — 100k rows blew
     # the budget in round 4's relay outage). Clearly flagged via
-    # platform=cpu in the child's `unit` string.
-    attempts.append((min(requested, 50_000), "cpu", budget * 0.75))
+    # platform=cpu in the child's `unit` string. Inference is far
+    # cheaper per row than training, so the predict bench keeps more.
+    cpu_rows = 300_000 if predict_mode else 50_000
+    attempts.append((min(requested, cpu_rows), "cpu", budget * 0.75))
 
     import tempfile
     queue = list(attempts)
@@ -187,9 +206,11 @@ def main():
     # Everything failed — still emit the contract line so the driver
     # records a structured result instead of a crash.
     print(json.dumps({
-        "metric": "boosting_iters_per_sec_higgs_shape",
+        "metric": ("predict_rows_per_sec" if predict_mode
+                   else "boosting_iters_per_sec_higgs_shape"),
         "value": 0.0,
-        "unit": "iters/sec (all attempts failed; see stderr)",
+        "unit": ("rows/sec" if predict_mode else "iters/sec")
+        + " (all attempts failed; see stderr)",
         "vs_baseline": 0.0,
     }))
     sys.exit(1)
@@ -315,8 +336,136 @@ def _measure():
           file=sys.stderr)
 
 
+def _random_trees(rng, num_trees: int, num_leaves: int, num_features: int):
+    """Synthetic 255-leaf ensembles for the serving bench: training 100
+    such trees on CPU would dwarf the attempt budget, and inference
+    throughput only depends on tree SHAPE, not split quality. Topology
+    follows the learner's numbering (internal node s splits an existing
+    leaf; left child keeps the parent's leaf id, right child becomes
+    leaf s+1)."""
+    from lightgbm_tpu.tree import Tree
+    trees = []
+    for _ in range(num_trees):
+        tr = Tree(num_leaves)
+        slot = {}  # leaf id -> (node, side) where that leaf hangs
+        for s in range(num_leaves - 1):
+            leaf = int(rng.randint(0, s + 1))
+            if leaf in slot:
+                node, side = slot.pop(leaf)
+                (tr.left_child if side == 0 else tr.right_child)[node] = s
+            tr.split_feature[s] = tr.split_feature_inner[s] = \
+                rng.randint(0, num_features)
+            tr.threshold[s] = rng.randn() * 0.7
+            tr.left_child[s] = ~leaf
+            tr.right_child[s] = ~(s + 1)
+            slot[leaf] = (s, 0)
+            slot[s + 1] = (s, 1)
+        tr.leaf_value[:] = rng.randn(num_leaves) * 0.1
+        trees.append(tr)
+    return trees
+
+
+def _measure_predict():
+    """Serving bench: rows/sec through the streaming inference engine
+    (vmapped tree-parallel traversal) vs the pre-engine per-tree scan,
+    same ensemble, same chunking — bit-equality asserted on a probe
+    block before timing."""
+    n = int(os.environ.get("BENCH_ROWS", 8_000_000))
+    t = int(os.environ.get("BENCH_PREDICT_TREES", 100))
+    leaves = int(os.environ.get("BENCH_PREDICT_LEAVES", 255))
+    f = 28
+    chunk = int(os.environ.get("BENCH_PREDICT_CHUNK", 1 << 20))
+
+    import jax
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    import numpy as np
+    from lightgbm_tpu.ops import predict as pred_ops
+
+    platform = jax.default_backend()
+    rng = np.random.RandomState(0)
+    trees = _random_trees(rng, t, leaves, f)
+    data = rng.randn(n, f).astype(np.float64)
+
+    class _Owner:  # packed-ensemble cache host
+        pass
+
+    owner = _Owner()
+
+    def engine_run():
+        return pred_ops.predict_raw_cached(owner, trees, 1, data, "bench",
+                                           chunk)
+
+    ens = pred_ops.pack_ensemble(trees, 1)
+
+    def scan_run():
+        # the pre-change path: per-tree lax.scan, exact chunk shapes
+        import jax.numpy as jnp
+        outs = []
+        for lo in range(0, n, chunk):
+            x = jnp.asarray(data[lo:lo + chunk], jnp.float32)
+            outs.append(np.asarray(pred_ops.predict_raw_scan(ens, x),
+                                   np.float64))
+        return np.concatenate(outs, axis=0)
+
+    # correctness probe: the engine must reproduce the scan path bitwise
+    probe = min(n, 10_000)
+    import jax.numpy as jnp
+    probe_scan = np.asarray(pred_ops.predict_raw_scan(
+        ens, jnp.asarray(data[:probe], jnp.float32)), np.float64)
+    probe_engine = pred_ops.predict_raw_cached(
+        _Owner(), trees, 1, data[:probe], "probe", chunk)
+    bit_equal = bool(np.array_equal(probe_scan, probe_engine))
+
+    engine_run()  # compile + warm
+    reps = int(os.environ.get("BENCH_PREDICT_REPS", 3))
+    t0 = time.time()
+    for _ in range(reps):
+        engine_run()
+    engine_rps = n * reps / (time.time() - t0)
+
+    scan_run()  # compile + warm
+    t0 = time.time()
+    scan_run()
+    scan_rps = n / (time.time() - t0)
+
+    unit = "rows/sec (N=%d, T=%d, %d leaves" % (n, t, leaves)
+    if platform != "tpu":
+        unit += ", platform=%s" % platform
+    if not bit_equal:
+        unit += ", PARITY-MISMATCH"
+    unit += ")"
+    result = {
+        "metric": "predict_rows_per_sec",
+        "value": round(engine_rps, 1),
+        "unit": unit,
+        # anchor: speedup over the per-tree-scan path this engine replaced
+        "vs_baseline": round(engine_rps / max(scan_rps, 1e-9), 4),
+        "scan_rows_per_sec": round(scan_rps, 1),
+    }
+    out_path = os.environ.get("BENCH_OUT")
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(json.dumps(result) + "\n")
+    else:
+        print(json.dumps(result), flush=True)
+    print("# platform=%s engine=%.0f rows/s scan=%.0f rows/s "
+          "speedup=%.2fx bit_equal=%s"
+          % (platform, engine_rps, scan_rps, engine_rps / max(scan_rps, 1e-9),
+             bit_equal), file=sys.stderr)
+
+
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD"):
-        _measure()
+        if os.environ.get("BENCH_MODE") == "predict":
+            _measure_predict()
+        else:
+            _measure()
     else:
         main()

@@ -364,9 +364,11 @@ class Booster:
         if model_file is not None:
             with open(model_file) as fh:
                 self._loaded = load_model_from_string(fh.read())
+            self._plumb_loaded_predict_params()
             return
         if model_str is not None:
             self._loaded = load_model_from_string(model_str)
+            self._plumb_loaded_predict_params()
             return
         if train_set is None:
             raise LightGBMError(
@@ -395,6 +397,18 @@ class Booster:
                                                   objective)
         else:
             self._gbdt = create_boosting(self.config, binned, objective)
+
+    def _plumb_loaded_predict_params(self) -> None:
+        """Serving knobs for a loaded (file/string) model: alias-resolve
+        the Booster params and hand tpu_predict_chunk / tpu_num_shards
+        to the LoadedModel's streaming predict engine."""
+        canon = {Config.canonical_key(k): v for k, v in self.params.items()}
+        chunk = canon.get("tpu_predict_chunk")
+        if chunk:
+            self._loaded.predict_chunk = int(chunk)
+        shards = int(canon.get("tpu_num_shards", 0) or 0)
+        if shards > 1:
+            self._loaded.predict_shards = shards
 
     # ------------------------------------------------------------------
     def _load_init_model(self, init_model) -> "Booster":
@@ -552,6 +566,17 @@ class Booster:
     def predict(self, data, start_iteration: int = 0, num_iteration: int = -1,
                 raw_score: bool = False, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs) -> np.ndarray:
+        # per-call serving-engine override (alias-aware), e.g.
+        # predict(X, tpu_predict_chunk=65536). Every alias is popped by
+        # MEMBERSHIP (a falsy value left behind would collide with the
+        # explicit kwarg in the sparse-batch recursion below)
+        predict_chunk = None
+        for key in ("tpu_predict_chunk", "predict_chunk",
+                    "predict_chunk_rows"):
+            if key in kwargs:
+                val = kwargs.pop(key)
+                if val and predict_chunk is None:
+                    predict_chunk = int(val)
         if _is_sparse(data):
             # tree traversal reads raw feature values: densify in
             # row batches so peak host memory stays bounded
@@ -563,7 +588,9 @@ class Booster:
                                      num_iteration=num_iteration,
                                      raw_score=raw_score,
                                      pred_leaf=pred_leaf,
-                                     pred_contrib=pred_contrib, **kwargs)
+                                     pred_contrib=pred_contrib,
+                                     tpu_predict_chunk=predict_chunk,
+                                     **kwargs)
                         for b in sparse_row_batches(data)]
                 return np.concatenate(outs, axis=0)
         data = np.asarray(data, dtype=np.float64)
@@ -580,14 +607,16 @@ class Booster:
                     num_iteration=num_iteration)
             return self._loaded.predict(data, raw_score=raw_score,
                                         start_iteration=start_iteration,
-                                        num_iteration=num_iteration)
+                                        num_iteration=num_iteration,
+                                        predict_chunk=predict_chunk)
         if num_iteration < 0 and self.best_iteration > 0:
             num_iteration = self.best_iteration
         return self._gbdt.predict(data, raw_score=raw_score,
                                   start_iteration=start_iteration,
                                   num_iteration=num_iteration,
                                   pred_leaf=pred_leaf,
-                                  pred_contrib=pred_contrib)
+                                  pred_contrib=pred_contrib,
+                                  predict_chunk=predict_chunk)
 
     def refit(self, data, label, decay_rate: float = 0.9, weight=None,
               **kwargs):

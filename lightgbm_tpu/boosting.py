@@ -1143,20 +1143,21 @@ class GBDT:
 
     # ------------------------------------------------------------------
     # prediction (ref: gbdt_prediction.cpp:16-91, predictor.hpp:31)
-    # Default path: packed device ensemble traversal (ops/predict.py) —
-    # one XLA program over [T] trees x [B] rows; host fallback for linear
-    # trees (per-leaf models live on host).
-    _PREDICT_CHUNK = 1 << 20
-
+    # Default path: the streaming tree-parallel inference engine
+    # (ops/predict.py) — vmapped traversal over the packed [T] trees,
+    # shape-bucketed chunking, optional mesh sharding; host fallback for
+    # linear trees (per-leaf models live on host).
     def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
+                    num_iteration: int = -1,
+                    predict_chunk: Optional[int] = None) -> np.ndarray:
         from .dataset import is_sparse, sparse_row_batches
         if is_sparse(data):
             if data.shape[0] == 0:
                 data = np.zeros(data.shape)
             else:
                 return np.concatenate(
-                    [self.predict_raw(b, start_iteration, num_iteration)
+                    [self.predict_raw(b, start_iteration, num_iteration,
+                                      predict_chunk=predict_chunk)
                      for b in sparse_row_batches(data)], axis=0)
         data = np.asarray(data, np.float64)
         end = len(self.models) if num_iteration < 0 else \
@@ -1174,10 +1175,14 @@ class GBDT:
             return self._predict_raw_host(data, start_iteration, end)
         from .ops.predict import predict_raw_cached
         key = (start_iteration, end, self.current_iteration())
+        chunk = (int(predict_chunk) if predict_chunk
+                 else int(self.config.tpu_predict_chunk or (1 << 20)))
+        shards = int(self.config.tpu_num_shards or 0)
         with global_tracer.span("predict/raw"):
             return predict_raw_cached(self, trees,
                                       self.num_tree_per_iteration,
-                                      data, key, self._PREDICT_CHUNK)
+                                      data, key, chunk,
+                                      num_shards=shards if shards > 1 else 0)
 
     def _predict_raw_host(self, data: np.ndarray, start_iteration: int,
                           end: int) -> np.ndarray:
@@ -1224,13 +1229,14 @@ class GBDT:
 
     def predict(self, data: np.ndarray, raw_score: bool = False,
                 start_iteration: int = 0, num_iteration: int = -1,
-                pred_leaf: bool = False, pred_contrib: bool = False
-                ) -> np.ndarray:
+                pred_leaf: bool = False, pred_contrib: bool = False,
+                predict_chunk: Optional[int] = None) -> np.ndarray:
         if pred_leaf:
             return self.predict_leaf(data, start_iteration, num_iteration)
         if pred_contrib:
             return self.predict_contrib(data, start_iteration, num_iteration)
-        raw = self.predict_raw(data, start_iteration, num_iteration)
+        raw = self.predict_raw(data, start_iteration, num_iteration,
+                               predict_chunk=predict_chunk)
         if raw.shape[1] == 1:
             raw = raw[:, 0]
         if raw_score or self.objective is None:
@@ -1761,8 +1767,10 @@ class RF(GBDT):
             self._base_grad = (g, h)
         return self._base_grad
 
-    def predict_raw(self, data, start_iteration=0, num_iteration=-1):
-        out = super().predict_raw(data, start_iteration, num_iteration)
+    def predict_raw(self, data, start_iteration=0, num_iteration=-1,
+                    predict_chunk=None):
+        out = super().predict_raw(data, start_iteration, num_iteration,
+                                  predict_chunk=predict_chunk)
         end = len(self.models) if num_iteration < 0 else \
             min(len(self.models), start_iteration + num_iteration)
         cnt = max(end - start_iteration, 1)

@@ -714,11 +714,14 @@ def booster_predict_for_file(handle: int, data_filename: str,
     params.setdefault("header", str(bool(data_has_header)).lower())
     X, _y, _w, _g = load_svmlight_or_csv(data_filename, params)
     bst = _get(handle)
+    canon = {Config.canonical_key(pk): pv for pk, pv in params.items()}
+    chunk = canon.get("tpu_predict_chunk")  # per-call serving override
     pred = bst.predict(X, start_iteration=start_iteration,
                        num_iteration=num_iteration,
                        raw_score=predict_type == _PREDICT_RAW,
                        pred_leaf=predict_type == _PREDICT_LEAF,
-                       pred_contrib=predict_type == _PREDICT_CONTRIB)
+                       pred_contrib=predict_type == _PREDICT_CONTRIB,
+                       tpu_predict_chunk=int(chunk) if chunk else None)
     pred = np.asarray(pred)
     if pred.ndim == 1:
         pred = pred[:, None]
@@ -758,10 +761,15 @@ def booster_get_leaf_value(handle: int, tree_idx: int,
 
 def _invalidate_packed(bst) -> None:
     """Drop the packed device-ensemble cache after structural edits
-    (ops/predict.py predict_raw_cached keys on owner._packed_key)."""
+    (ops/predict.py predict_raw_cached keys on owner._packed_key; the
+    incremental EnsemblePackers identify trees by (id, pack_version)
+    tokens, which in-place leaf edits don't change — so they must be
+    dropped wholesale too)."""
     for owner in (bst._gbdt, getattr(bst, "_loaded", None)):
         if owner is not None and hasattr(owner, "_packed_key"):
             owner._packed_key = None
+        if owner is not None and hasattr(owner, "_packers"):
+            owner._packers = {}
 
 
 def booster_set_leaf_value(handle: int, tree_idx: int, leaf_idx: int,

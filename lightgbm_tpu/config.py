@@ -175,6 +175,7 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_hist_impl": [],
     "tpu_sparse_hist": [],
     "tpu_dart_fused_max_bytes": [],
+    "tpu_predict_chunk": ["predict_chunk", "predict_chunk_rows"],
 }
 
 _ALIAS_TO_CANONICAL: Dict[str, str] = {}
@@ -484,6 +485,12 @@ class Config:
     # recomputed without host round-trips) is only kept below this many
     # bytes; above it DART falls back to the host loop.
     tpu_dart_fused_max_bytes: int = 2 << 30
+    # serving: rows per device dispatch of the streaming prediction
+    # engine (ops/predict.py predict_raw_cached). Chunks are
+    # shape-bucketed — full chunks run at exactly this size, the uneven
+    # tail pads up to a power-of-two bucket — so any N reuses a small
+    # fixed set of compiled traversal programs.
+    tpu_predict_chunk: int = 1 << 20
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)

@@ -200,9 +200,10 @@ def _sharded_pallas_build(shard_mesh, *, max_bins: int, dtype,
         global_metrics.note_collective("psum", out.size * out.dtype.itemsize)
         return out
 
-    fn = jax.shard_map(local, mesh=shard_mesh,
-                       in_specs=(P(None, axis), P(axis), P(axis), P(axis)),
-                       out_specs=P(), check_vma=False)
+    from .parallel.mesh import shard_map as _shard_map
+    fn = _shard_map(local, mesh=shard_mesh,
+                    in_specs=(P(None, axis), P(axis), P(axis), P(axis)),
+                    out_specs=P())
 
     def build(bins, g, h, m):
         # padded rows carry mask 0 -> no histogram contribution
@@ -242,9 +243,10 @@ def _sharded_pallas_multi(shard_mesh, *, max_bins: int,
         global_metrics.note_collective("psum", out.size * out.dtype.itemsize)
         return out
 
-    fn = jax.shard_map(local, mesh=shard_mesh,
-                       in_specs=(P(None, axis), P(axis, None), P(axis), P()),
-                       out_specs=P(), check_vma=False)
+    from .parallel.mesh import shard_map as _shard_map
+    fn = _shard_map(local, mesh=shard_mesh,
+                    in_specs=(P(None, axis), P(axis, None), P(axis), P()),
+                    out_specs=P())
 
     def multi(bins, ghT, row_leaf, ids):
         # padded rows: leaf id -1 matches no slot (slots are >= 0 or the

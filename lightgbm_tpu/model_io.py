@@ -104,6 +104,10 @@ class LoadedModel:
         self.average_output = False
         self.params = {}
         self.label_index = 0
+        # serving knobs (plumbed from the loading Booster's params;
+        # ops/predict.py streaming engine)
+        self.predict_chunk: Optional[int] = None
+        self.predict_shards: int = 0
 
     @property
     def num_iterations(self) -> int:
@@ -112,7 +116,8 @@ class LoadedModel:
         return len(self.trees) // self.num_tree_per_iteration
 
     def predict_raw(self, data: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
+                    num_iteration: int = -1,
+                    predict_chunk: Optional[int] = None) -> np.ndarray:
         data = np.asarray(data, np.float64)
         n = data.shape[0]
         k = self.num_tree_per_iteration
@@ -127,7 +132,9 @@ class LoadedModel:
         else:
             from .ops.predict import predict_raw_cached
             key = (start_iteration, end, len(self.trees))
-            out = predict_raw_cached(self, trees, k, data, key)
+            chunk = int(predict_chunk or self.predict_chunk or (1 << 20))
+            out = predict_raw_cached(self, trees, k, data, key, chunk,
+                                     num_shards=self.predict_shards)
         if self.average_output and end > start_iteration:
             out /= (end - start_iteration)
         return out

@@ -45,6 +45,11 @@ class MetricsRegistry:
         # static run facts (mesh size, learner kind, ...), set once at
         # setup — not per-iteration, so always-on is free
         self.meta: Dict[str, Any] = {}
+        # serving throughput accumulators (always live: two adds per
+        # predict CALL, not per row — the predict analog of the
+        # trace-time counters)
+        self.predict_rows_total = 0
+        self.predict_seconds_total = 0.0
 
     # ------------------------------------------------------------------
     def enable(self) -> None:
@@ -64,6 +69,8 @@ class MetricsRegistry:
         self.collective_calls = 0
         self.collective_bytes = 0
         self.meta.clear()
+        self.predict_rows_total = 0
+        self.predict_seconds_total = 0.0
 
     def set_meta(self, key: str, value) -> None:
         self.meta[key] = value
@@ -145,6 +152,26 @@ class MetricsRegistry:
         if tag is not None:
             return self.trace_counts.get(tag, 0)
         return sum(self.trace_counts.values())
+
+    def note_predict(self, rows: int, seconds: float) -> None:
+        """Account one serving-path predict dispatch (ops/predict.py
+        streaming engine). Always-on and O(1); feeds the
+        `predict_rows_per_sec` serving metric (bench.py --predict) and,
+        when an iteration record is open (predict during training),
+        the per-iteration row/time totals."""
+        self.predict_rows_total += int(rows)
+        self.predict_seconds_total += float(seconds)
+        cur = self._current
+        if self.enabled and cur is not None:
+            cur["predict_rows"] = cur.get("predict_rows", 0) + int(rows)
+            cur["predict_seconds"] = (cur.get("predict_seconds", 0.0)
+                                      + float(seconds))
+
+    def predict_rows_per_sec(self) -> float:
+        """Cumulative serving throughput since the last reset()."""
+        if self.predict_seconds_total <= 0.0:
+            return 0.0
+        return self.predict_rows_total / self.predict_seconds_total
 
     def note_collective(self, op: str, nbytes: int) -> None:
         """Account one collective (psum/all_gather) emitted into a traced

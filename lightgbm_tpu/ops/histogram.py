@@ -42,11 +42,13 @@ def _hist_all_features(bins_fm: jax.Array, gh: jax.Array, max_bins: int,
 
 
 def cpu_backend() -> bool:
-    """True when the default jax backend is CPU (or undeterminable) —
-    the shared sniff for backend-dependent implementation choices."""
+    """True when the default jax backend is CPU (or unavailable) —
+    the shared sniff for backend-dependent implementation choices.
+    Only the backend-unavailable RuntimeError maps to "cpu"; any other
+    failure is a real bug in backend sniffing and must surface."""
     try:
         return jax.default_backend() == "cpu"
-    except Exception:
+    except RuntimeError:  # "Unable to initialize backend ..."
         return True
 
 

@@ -258,9 +258,9 @@ def make_sharded_feature_grow(mesh, *, num_leaves: int, max_bins: int,
     meta_spec = FeatureMeta(*([rep] * len(FeatureMeta._fields)))
     hp_spec = SplitHyperParams(*([rep] * len(SplitHyperParams._fields)))
     tree_spec = TreeArrays(*([rep] * len(TreeArrays._fields)))
-    sharded = jax.shard_map(
+    from .mesh import shard_map as _shard_map
+    sharded = _shard_map(
         grow, mesh=mesh,
         in_specs=(rep, rep, rep, rep, rep, meta_spec, hp_spec, rep),
-        out_specs=(tree_spec, rep),
-        check_vma=False)
+        out_specs=(tree_spec, rep))
     return jax.jit(sharded)

@@ -25,6 +25,24 @@ DATA_AXIS = "data"
 _active_mesh: Optional[Mesh] = None
 
 
+def shard_map(f, mesh: Mesh, in_specs, out_specs):
+    """`shard_map` across jax versions: new jax exposes `jax.shard_map`
+    (replication check flag `check_vma`), older releases only
+    `jax.experimental.shard_map.shard_map` (`check_rep`). Every
+    shard_mapped program in this framework goes through here."""
+    sm = getattr(jax, "shard_map", None)
+    if sm is not None:
+        try:
+            return sm(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_vma=False)
+        except TypeError:  # pre-rename flag spelling
+            return sm(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+    from jax.experimental.shard_map import shard_map as sm_exp
+    return sm_exp(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
 def get_mesh(num_shards: int = 0, devices=None) -> Mesh:
     """Build (or fetch) a 1-D data-parallel mesh.
 
@@ -58,3 +76,20 @@ def replicate(mesh: Mesh, array):
 def num_machines() -> int:
     """Reference Network::num_machines analog."""
     return _active_mesh.size if _active_mesh is not None else 1
+
+
+def data_sharding(mesh: Mesh, ndim: int, row_axis: int = 0) -> NamedSharding:
+    """NamedSharding placing an ndim-array's `row_axis` over "data" —
+    the serving engine uses this to land prediction chunks pre-sharded
+    so the shard_mapped traversal starts without a reshard
+    (ops/predict.py predict_raw_cached)."""
+    spec = [None] * ndim
+    spec[row_axis] = DATA_AXIS
+    return NamedSharding(mesh, P(*spec))
+
+
+def pad_rows_to_shards(n: int, mesh: Mesh) -> int:
+    """Smallest row count >= n divisible by the mesh's data axis (row
+    blocks fed to shard_map must split evenly across devices)."""
+    s = max(mesh.size, 1)
+    return -(-n // s) * s

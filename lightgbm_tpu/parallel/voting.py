@@ -310,9 +310,8 @@ def make_sharded_voting_grow(mesh, *, num_leaves: int, max_bins: int,
     meta_spec = FeatureMeta(*([rep] * len(FeatureMeta._fields)))
     hp_spec = SplitHyperParams(*([rep] * len(SplitHyperParams._fields)))
     tree_spec = TreeArrays(*([rep] * len(TreeArrays._fields)))
-    sharded = jax.shard_map(
+    sharded = mesh_lib.shard_map(
         grow, mesh=mesh,
         in_specs=(data, rows, rows, rows, rep, meta_spec, hp_spec, rep),
-        out_specs=(tree_spec, rows),
-        check_vma=False)
+        out_specs=(tree_spec, rows))
     return jax.jit(sharded)

@@ -79,6 +79,7 @@ def refit_booster(booster, data, label, decay_rate: float = 0.9,
                 new_out = -gsum / (hsum + lam) * tree.shrinkage
                 tree.leaf_value[leaf] = (decay_rate * tree.leaf_value[leaf]
                                          + (1.0 - decay_rate) * new_out)
+            tree.pack_version += 1  # leaf edits invalidate packed slots
             scores[ki] += tree.leaf_value[leaves]
             t += 1
     return new_booster

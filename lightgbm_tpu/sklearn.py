@@ -227,10 +227,12 @@ class LGBMModel:
                 num_iteration: int = -1, pred_leaf: bool = False,
                 pred_contrib: bool = False, **kwargs):
         self._check_fitted()
+        # serving-engine kwargs (tpu_predict_chunk, ...) pass through to
+        # Booster.predict
         return self._Booster.predict(
             X, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration, pred_leaf=pred_leaf,
-            pred_contrib=pred_contrib)
+            pred_contrib=pred_contrib, **kwargs)
 
 
 class LGBMRegressor(LGBMModel):

@@ -51,6 +51,11 @@ class Tree:
         self.cat_boundaries: List[int] = [0]
         self.cat_threshold: List[int] = []  # packed uint32 bitsets
         self.num_cat = 0
+        # bumped by in-place leaf-value mutation (apply_shrinkage /
+        # add_bias / refit renewal) so the incremental ensemble packer
+        # (ops/predict.py EnsemblePacker) can detect stale packed slots
+        # by (id, pack_version) token
+        self.pack_version = 0
         # linear-tree leaves (ref: tree.h is_linear_, LinearTreeLearner)
         self.is_linear = False
         self.leaf_const = np.zeros(n, np.float64)
@@ -140,6 +145,7 @@ class Tree:
     # ------------------------------------------------------------------
     def apply_shrinkage(self, rate: float) -> None:
         """(ref: tree.h:189 Tree::Shrinkage)"""
+        self.pack_version += 1
         self.leaf_value *= rate
         self.internal_value *= rate
         self.shrinkage *= rate
@@ -148,6 +154,7 @@ class Tree:
             self.leaf_coeff = [c * rate for c in self.leaf_coeff]
 
     def add_bias(self, value: float) -> None:
+        self.pack_version += 1
         self.leaf_value += value
         self.internal_value += value
         if self.is_linear:
