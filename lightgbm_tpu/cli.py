@@ -60,6 +60,42 @@ def parse_cli_args(argv: List[str]) -> Dict[str, str]:
     return params
 
 
+def conform_prediction_data(data: np.ndarray, need: int,
+                            disable_shape_check: bool) -> np.ndarray:
+    """Feature-count gate shared by task=predict and task=serve (ref:
+    predict_disable_shape_check — the reference aborts on mismatch
+    unless the check is disabled, then pads with NaN / truncates)."""
+    if data.shape[1] == need:
+        return data
+    if not disable_shape_check:
+        raise LightGBMError(
+            f"prediction data has {data.shape[1]} features but the "
+            f"model expects {need}; set "
+            "predict_disable_shape_check=true to pad/truncate")
+    if data.shape[1] < need:
+        pad = np.full((data.shape[0], need - data.shape[1]), np.nan)
+        return np.hstack([data, pad])
+    return data[:, :need]
+
+
+def write_prediction_file(path: str, preds_iter) -> int:
+    """Write prediction arrays (an iterable — one block per request for
+    task=serve, a single block for task=predict) as `%g` lines; returns
+    the row count."""
+    rows = 0
+    with open(path, "w") as fh:
+        for preds in preds_iter:
+            arr = np.asarray(preds)
+            if arr.ndim == 1:
+                for v in arr:
+                    fh.write(f"{v:g}\n")
+            else:
+                for row in arr:
+                    fh.write("\t".join(f"{v:g}" for v in row) + "\n")
+            rows += arr.shape[0] if arr.ndim else 1
+    return rows
+
+
 class Application:
     """One CLI run (ref: src/application/application.cpp:35)."""
 
@@ -79,6 +115,8 @@ class Application:
             self._refit()
         elif task == "save_binary":
             self._save_binary()
+        elif task == "serve":
+            self._serve()
         else:
             raise LightGBMError(f"unknown task: {task}")
 
@@ -140,20 +178,8 @@ class Application:
         from .io.text_loader import load_svmlight_or_csv
         data, _label, _w, _g = load_svmlight_or_csv(cfg.data,
                                                     dict(self.params))
-        # feature-count check (ref: predict_disable_shape_check — the
-        # reference aborts on mismatch unless the check is disabled)
-        need = booster.num_feature()
-        if data.shape[1] != need:
-            if not cfg.predict_disable_shape_check:
-                raise LightGBMError(
-                    f"prediction data has {data.shape[1]} features but the "
-                    f"model expects {need}; set "
-                    "predict_disable_shape_check=true to pad/truncate")
-            if data.shape[1] < need:
-                pad = np.full((data.shape[0], need - data.shape[1]), np.nan)
-                data = np.hstack([data, pad])
-            else:
-                data = data[:, :need]
+        data = conform_prediction_data(data, booster.num_feature(),
+                                       cfg.predict_disable_shape_check)
         preds = booster.predict(
             data,
             start_iteration=cfg.start_iteration_predict,
@@ -161,16 +187,9 @@ class Application:
             raw_score=cfg.predict_raw_score,
             pred_leaf=cfg.predict_leaf_index,
             pred_contrib=cfg.predict_contrib)
-        preds = np.asarray(preds)
-        with open(cfg.output_result, "w") as fh:
-            if preds.ndim == 1:
-                for v in preds:
-                    fh.write(f"{v:g}\n")
-            else:
-                for row in preds:
-                    fh.write("\t".join(f"{v:g}" for v in row) + "\n")
+        rows = write_prediction_file(cfg.output_result, [preds])
         if cfg.verbosity >= 0:
-            print(f"[LightGBM-TPU] predictions for {preds.shape[0]} rows "
+            print(f"[LightGBM-TPU] predictions for {rows} rows "
                   f"written to {cfg.output_result}")
 
     # ------------------------------------------------------------------
@@ -209,6 +228,29 @@ class Application:
                   f"{cfg.output_model}")
 
     # ------------------------------------------------------------------
+    def _serve(self) -> None:
+        """Replay a data file through the async model server (serve/)
+        as concurrent mixed-size requests — the thin CLI front of the
+        in-process serving API (`python -m lightgbm_tpu serve
+        input_model=m.txt data=rows.csv`). Predictions are written to
+        output_result in row order; one summary JSON line (request
+        p50/p95/p99, rows/sec, serve counters) goes to stdout."""
+        cfg = self.config
+        if not cfg.input_model:
+            raise LightGBMError("task=serve requires input_model=")
+        if not cfg.data:
+            raise LightGBMError("task=serve requires data=")
+        from .serve.server import serve_file
+        stats = serve_file(cfg.input_model, cfg.data, cfg.output_result,
+                           dict(self.params))
+        if cfg.verbosity >= 0:
+            import json
+            print(json.dumps(stats))
+            print(f"[LightGBM-TPU] served {stats['requests']} requests "
+                  f"({stats['rows']} rows) in {stats['seconds']:.3f} s; "
+                  f"predictions written to {cfg.output_result}")
+
+    # ------------------------------------------------------------------
     def _save_binary(self) -> None:
         """Bin the dataset and store the binned form for fast reload
         (ref: task=save_binary, Dataset::SaveBinaryFile dataset.h:710)."""
@@ -223,8 +265,12 @@ class Application:
 def main(argv: Optional[List[str]] = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if not argv:
-        print("usage: python -m lightgbm_tpu config=<file> [key=value ...]")
+        print("usage: python -m lightgbm_tpu config=<file> [key=value ...]\n"
+              "       python -m lightgbm_tpu serve input_model=<model> "
+              "data=<file> [key=value ...]")
         return 1
+    if argv[0] == "serve":  # `python -m lightgbm_tpu serve ...` sugar
+        argv = ["task=serve"] + list(argv[1:])
     try:
         Application(argv).run()
     except (LightGBMError, OSError, ValueError) as exc:

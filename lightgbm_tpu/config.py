@@ -176,6 +176,12 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_sparse_hist": [],
     "tpu_dart_fused_max_bytes": [],
     "tpu_predict_chunk": ["predict_chunk", "predict_chunk_rows"],
+    # serving knobs (serve/ subsystem)
+    "serve_max_batch_rows": ["serve_max_batch"],
+    "serve_max_wait_ms": ["serve_max_wait"],
+    "serve_lowlat_max_rows": ["serve_lowlat_rows"],
+    "serve_cache_bytes": ["serve_pack_budget_bytes"],
+    "serve_request_rows": [],
 }
 
 _ALIAS_TO_CANONICAL: Dict[str, str] = {}
@@ -491,6 +497,20 @@ class Config:
     # tail pads up to a power-of-two bucket — so any N reuses a small
     # fixed set of compiled traversal programs.
     tpu_predict_chunk: int = 1 << 20
+    # serving (serve/ async model server; task=serve and the in-process
+    # API). Micro-batching: requests coalesce until serve_max_batch_rows
+    # rows are pending or the OLDEST pending request has waited
+    # serve_max_wait_ms; requests of <= serve_lowlat_max_rows rows skip
+    # the batcher entirely and dispatch through the AOT-compiled
+    # low-latency path. serve_cache_bytes bounds the total packed-
+    # ensemble bytes the multi-tenant registry keeps resident (LRU pack
+    # eviction; 0 = unbounded). serve_request_rows is the CLI replay's
+    # rows-per-request (0 = a mixed small/large size cycle).
+    serve_max_batch_rows: int = 8192
+    serve_max_wait_ms: float = 2.0
+    serve_lowlat_max_rows: int = 64
+    serve_cache_bytes: int = 1 << 30
+    serve_request_rows: int = 0
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)

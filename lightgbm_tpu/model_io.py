@@ -89,6 +89,30 @@ def save_model_to_string(booster, num_iteration: int = -1,
     return out
 
 
+def transform_raw(objective_str: str, raw: np.ndarray) -> np.ndarray:
+    """Raw scores -> output space for a serialized objective string
+    (the prediction-side ConvertOutput of gbdt_prediction.cpp). Shared
+    by LoadedModel.predict and the serve/ request path, so a served
+    probability is bit-identical to a direct `predict` call."""
+    obj = objective_str.split()[0] if objective_str else ""
+    if obj == "binary":
+        sig = 1.0
+        for tok in objective_str.split()[1:]:
+            if tok.startswith("sigmoid:"):
+                sig = float(tok.split(":")[1])
+        return 1.0 / (1.0 + np.exp(-sig * raw))
+    if obj == "multiclass":
+        e = np.exp(raw - raw.max(axis=-1, keepdims=True))
+        return e / e.sum(axis=-1, keepdims=True)
+    if obj == "multiclassova":
+        return 1.0 / (1.0 + np.exp(-raw))
+    if obj in ("poisson", "gamma", "tweedie"):
+        return np.exp(raw)
+    if obj == "cross_entropy":
+        return 1.0 / (1.0 + np.exp(-raw))
+    return raw
+
+
 class LoadedModel:
     """A model parsed from text — enough state to predict and continue
     inspection (ref: GBDT::LoadModelFromString gbdt_model_text.cpp:425)."""
@@ -158,23 +182,7 @@ class LoadedModel:
             raw = raw[:, 0]
         if raw_score:
             return raw
-        obj = self.objective_str.split()[0] if self.objective_str else ""
-        if obj == "binary":
-            sig = 1.0
-            for tok in self.objective_str.split()[1:]:
-                if tok.startswith("sigmoid:"):
-                    sig = float(tok.split(":")[1])
-            return 1.0 / (1.0 + np.exp(-sig * raw))
-        if obj == "multiclass":
-            e = np.exp(raw - raw.max(axis=-1, keepdims=True))
-            return e / e.sum(axis=-1, keepdims=True)
-        if obj == "multiclassova":
-            return 1.0 / (1.0 + np.exp(-raw))
-        if obj in ("poisson", "gamma", "tweedie"):
-            return np.exp(raw)
-        if obj == "cross_entropy":
-            return 1.0 / (1.0 + np.exp(-raw))
-        return raw
+        return transform_raw(self.objective_str, raw)
 
 
 def loaded_model_to_string(model: LoadedModel, num_iteration: int = -1,

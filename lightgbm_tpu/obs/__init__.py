@@ -18,6 +18,8 @@ allocates nothing per span/observation.
 """
 
 from .trace import Tracer, global_tracer  # noqa: F401
-from .metrics import MetricsRegistry, global_metrics  # noqa: F401
+from .metrics import (LatencyReservoir, MetricsRegistry,  # noqa: F401
+                      global_metrics)
 
-__all__ = ["Tracer", "global_tracer", "MetricsRegistry", "global_metrics"]
+__all__ = ["Tracer", "global_tracer", "LatencyReservoir",
+           "MetricsRegistry", "global_metrics"]

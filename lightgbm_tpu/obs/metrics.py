@@ -27,8 +27,69 @@ callbacks to ``train``.
 from __future__ import annotations
 
 import os
+import random
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class LatencyReservoir:
+    """Streaming latency quantiles over a bounded uniform reservoir
+    (Vitter's Algorithm R): O(1) `note`, O(capacity) memory no matter
+    how many samples arrive, and any retained sample is a uniform draw
+    from the full stream — so p50/p95/p99 stay unbiased over a run.
+
+    This is the ONE percentile primitive for serving telemetry:
+    ``note_predict`` (bulk predict dispatches) and the serve/ request
+    path both record through it instead of keeping local sample lists.
+    The RNG is seeded per reservoir, so summaries are reproducible for
+    a deterministic request sequence.
+    """
+
+    __slots__ = ("capacity", "count", "total_seconds", "max_seconds",
+                 "_samples", "_rng")
+
+    def __init__(self, capacity: int = 4096, seed: int = 0) -> None:
+        self.capacity = max(int(capacity), 1)
+        self.count = 0
+        self.total_seconds = 0.0
+        self.max_seconds = 0.0
+        self._samples: List[float] = []
+        self._rng = random.Random(seed)
+
+    def note(self, seconds: float) -> None:
+        s = float(seconds)
+        self.count += 1
+        self.total_seconds += s
+        if s > self.max_seconds:
+            self.max_seconds = s
+        if len(self._samples) < self.capacity:
+            self._samples.append(s)
+        else:
+            j = self._rng.randrange(self.count)
+            if j < self.capacity:
+                self._samples[j] = s
+
+    def quantiles(self, qs: Sequence[float]) -> Tuple[float, ...]:
+        """Nearest-rank quantiles over the reservoir (0.0 when empty)."""
+        if not self._samples:
+            return tuple(0.0 for _ in qs)
+        ordered = sorted(self._samples)
+        last = len(ordered) - 1
+        return tuple(ordered[min(int(q * len(ordered)), last)] for q in qs)
+
+    def summary(self) -> Dict[str, Any]:
+        """p50/p95/p99 + count/mean/max, in milliseconds — the shape
+        emitted into bench/serve JSON lines."""
+        p50, p95, p99 = self.quantiles((0.50, 0.95, 0.99))
+        mean = self.total_seconds / self.count if self.count else 0.0
+        return {
+            "count": self.count,
+            "p50_ms": round(p50 * 1e3, 4),
+            "p95_ms": round(p95 * 1e3, 4),
+            "p99_ms": round(p99 * 1e3, 4),
+            "mean_ms": round(mean * 1e3, 4),
+            "max_ms": round(self.max_seconds * 1e3, 4),
+        }
 
 
 class MetricsRegistry:
@@ -50,6 +111,13 @@ class MetricsRegistry:
         # trace-time counters)
         self.predict_rows_total = 0
         self.predict_seconds_total = 0.0
+        # serving-path telemetry (always live, O(1) per event): named
+        # latency reservoirs ("predict", "serve/request", ...) and flat
+        # event counters ("serve/registry_hit", "serve/pack_evictions",
+        # ...) — the serve/ subsystem records through these instead of
+        # keeping server-local sample lists
+        self.latency_reservoirs: Dict[str, LatencyReservoir] = {}
+        self.counters: Dict[str, int] = {}
 
     # ------------------------------------------------------------------
     def enable(self) -> None:
@@ -71,6 +139,8 @@ class MetricsRegistry:
         self.meta.clear()
         self.predict_rows_total = 0
         self.predict_seconds_total = 0.0
+        self.latency_reservoirs.clear()
+        self.counters.clear()
 
     def set_meta(self, key: str, value) -> None:
         self.meta[key] = value
@@ -153,14 +223,42 @@ class MetricsRegistry:
             return self.trace_counts.get(tag, 0)
         return sum(self.trace_counts.values())
 
+    # ------------------------------------------------------------------
+    # serving telemetry (always live, O(1) per event)
+    def latency(self, name: str) -> LatencyReservoir:
+        """The named latency reservoir, created on first use."""
+        res = self.latency_reservoirs.get(name)
+        if res is None:
+            res = self.latency_reservoirs[name] = LatencyReservoir()
+        return res
+
+    def note_latency(self, name: str, seconds: float) -> None:
+        self.latency(name).note(seconds)
+
+    def reset_latency(self, name: str) -> LatencyReservoir:
+        """Replace the named reservoir (bench --serve resets between the
+        warmup and measured phases) and return the fresh one."""
+        res = self.latency_reservoirs[name] = LatencyReservoir()
+        return res
+
+    def latency_summary(self, name: str) -> Dict[str, Any]:
+        return self.latency(name).summary()
+
+    def inc_counter(self, name: str, n: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + int(n)
+
+    def counter(self, name: str) -> int:
+        return self.counters.get(name, 0)
+
     def note_predict(self, rows: int, seconds: float) -> None:
         """Account one serving-path predict dispatch (ops/predict.py
         streaming engine). Always-on and O(1); feeds the
-        `predict_rows_per_sec` serving metric (bench.py --predict) and,
-        when an iteration record is open (predict during training),
-        the per-iteration row/time totals."""
+        `predict_rows_per_sec` serving metric (bench.py --predict), the
+        "predict" latency reservoir, and, when an iteration record is
+        open (predict during training), the per-iteration totals."""
         self.predict_rows_total += int(rows)
         self.predict_seconds_total += float(seconds)
+        self.note_latency("predict", seconds)
         cur = self._current
         if self.enabled and cur is not None:
             cur["predict_rows"] = cur.get("predict_rows", 0) + int(rows)

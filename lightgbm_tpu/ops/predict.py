@@ -194,6 +194,15 @@ class EnsemblePacker:
         # pins the object so its id can't be recycled while tracked
         return (tr, getattr(tr, "pack_version", 0))
 
+    @property
+    def nbytes(self) -> int:
+        """Host bytes held by the packed tensors. The cached device
+        ensemble mirrors the same shapes, so total resident cost is
+        ~2x this — serve/registry.py budgets with that factor."""
+        if self._arrs is None:
+            return 0
+        return sum(a.nbytes for a in self._arrs.values())
+
     # -- public --------------------------------------------------------
     def update(self, trees: List, num_tree_per_iteration: int = 1,
                pad: bool = True) -> PackedEnsemble:

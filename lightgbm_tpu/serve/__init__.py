@@ -1,0 +1,31 @@
+"""Model serving subsystem: async multi-tenant server over the
+tree-parallel inference engine (ops/predict.py).
+
+The engine speaks large offline batches; production traffic is many
+small concurrent requests. This package turns one into the other:
+
+- ``registry``  — multi-tenant model registry: named models, LRU
+  eviction of their packed-ensemble bytes under a configurable budget.
+- ``batcher``   — deadline-bounded micro-batching: concurrent requests
+  coalesce into one engine dispatch that lands in the already-warm
+  shape buckets (max-wait + max-batch knobs; results bit-identical to
+  calling ``predict`` directly, because row traversal is independent
+  per row and the per-row f32 accumulation order never changes).
+- ``lowlat``    — the dedicated B<=64 path: per-model AOT-compiled
+  traversal executables that bypass the batch machinery entirely.
+- ``server``    — the asyncio front that routes requests by size,
+  tracks per-request latency into ``obs.metrics`` p50/p95/p99
+  reservoirs, and backs ``python -m lightgbm_tpu serve`` and
+  ``bench.py --serve``.
+"""
+
+from .registry import ModelRegistry, ServedModel  # noqa: F401
+from .batcher import MicroBatcher  # noqa: F401
+from .lowlat import SERVE_LOWLAT_TAG, LowLatencyPredictor  # noqa: F401
+from .server import ModelServer, replay, serve_file  # noqa: F401
+
+__all__ = [
+    "ModelRegistry", "ServedModel", "MicroBatcher",
+    "LowLatencyPredictor", "SERVE_LOWLAT_TAG",
+    "ModelServer", "replay", "serve_file",
+]

@@ -1,0 +1,126 @@
+"""Deadline-bounded micro-batching of concurrent predict requests.
+
+Many small concurrent requests are the wrong shape for the inference
+engine; one medium batch is the right one. The batcher coalesces: a
+request appends its rows to the pending queue and awaits a future; the
+queue flushes when either
+
+- the pending rows reach ``max_batch_rows`` (size trigger), or
+- the OLDEST pending request has waited ``max_wait_s`` (deadline
+  trigger — the timer starts when the queue becomes non-empty and is
+  never extended by later arrivals, so no request waits more than
+  ``max_wait_s`` before its batch is dispatched).
+
+A flush concatenates the pending rows, runs ``predict_fn`` on the
+executor (so the event loop keeps accepting requests while the device
+works — that in-flight window is exactly where the next batch
+coalesces), and scatters row slices back to the per-request futures.
+
+Bit-parity: tree traversal is independent per row and the per-row f32
+class-sum order does not depend on batch size, so a coalesced request's
+slice is bit-identical to calling ``predict_fn`` on its rows alone
+(asserted by tests/test_serve.py). A single oversized request (more
+rows than ``max_batch_rows``) dispatches immediately as its own batch —
+the engine's chunking handles arbitrarily large row counts.
+
+Single-loop use only: all bookkeeping runs on the event-loop thread, so
+no locks are needed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Awaitable, Callable, List, Tuple
+
+import numpy as np
+
+from ..obs.metrics import global_metrics
+
+
+class MicroBatcher:
+    def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
+                 max_batch_rows: int = 8192, max_wait_s: float = 0.002,
+                 executor=None):
+        self._predict_fn = predict_fn
+        self.max_batch_rows = max(int(max_batch_rows), 1)
+        self.max_wait_s = max(float(max_wait_s), 0.0)
+        self._executor = executor
+        self._pending: List[Tuple[np.ndarray, asyncio.Future]] = []
+        self._pending_rows = 0
+        self._timer = None
+        self._oldest_t0 = 0.0
+
+    @property
+    def pending_rows(self) -> int:
+        return self._pending_rows
+
+    # ------------------------------------------------------------------
+    def submit(self, x: np.ndarray) -> Awaitable[np.ndarray]:
+        """Queue `x` ([B, F]) for the next coalesced dispatch; resolves
+        to the raw [B, K] scores for exactly these rows. Must be called
+        on the event-loop thread."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        if self._pending and \
+                self._pending_rows + x.shape[0] > self.max_batch_rows:
+            # dispatching this arrival with the queue would overshoot
+            # the cap: flush first, so steady-state batches never
+            # exceed max_batch_rows (and never outgrow the warmed
+            # shape-bucket set — only a single oversized request can)
+            self._flush(loop)
+        if not self._pending:
+            self._oldest_t0 = time.perf_counter()
+        self._pending.append((x, fut))
+        self._pending_rows += x.shape[0]
+        if self._pending_rows >= self.max_batch_rows:
+            self._flush(loop)
+        elif self._timer is None:
+            self._timer = loop.call_later(self.max_wait_s,
+                                          self._flush, loop)
+        return fut
+
+    def flush(self) -> None:
+        """Force-dispatch whatever is pending (server shutdown path)."""
+        if self._pending:
+            self._flush(asyncio.get_running_loop())
+
+    # ------------------------------------------------------------------
+    def _flush(self, loop) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        batch = self._pending
+        if not batch:
+            return
+        self._pending = []
+        self._pending_rows = 0
+
+        xs = [x for x, _ in batch]
+        xcat = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
+        global_metrics.inc_counter("serve/batches")
+        global_metrics.inc_counter("serve/batched_rows", xcat.shape[0])
+        if len(batch) > 1:
+            global_metrics.inc_counter("serve/coalesced_requests",
+                                       len(batch))
+        global_metrics.note_latency(
+            "serve/batch_wait", time.perf_counter() - self._oldest_t0)
+
+        task = loop.run_in_executor(self._executor, self._predict_fn, xcat)
+
+        def scatter(done: asyncio.Future) -> None:
+            try:
+                out = done.result()
+            except BaseException as exc:  # propagate to every waiter
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                return
+            lo = 0
+            for x, fut in batch:
+                hi = lo + x.shape[0]
+                if not fut.done():  # waiter may have been cancelled
+                    fut.set_result(out[lo:hi])
+                lo = hi
+
+        task.add_done_callback(scatter)

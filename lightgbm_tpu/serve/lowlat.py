@@ -1,0 +1,140 @@
+"""Dedicated low-latency predict path for small requests (B <= 64).
+
+The streaming engine (ops/predict.py predict_raw_cached) is built for
+throughput: packer token revalidation, chunk planning, double-buffered
+staging. At B=1..64 that machinery costs more than the traversal, so
+the server routes small requests here instead: per model, the traversal
+program is AOT-compiled ONCE per (row-bucket, feature-width) via
+``jax.jit(...).lower(...).compile()`` and then invoked directly as an
+executable — no jit-cache lookup, no tracing, structurally zero
+steady-state recompiles (the compiled handle cannot re-trace).
+
+Rows pad up to a power-of-two bucket ({1, 2, 4, ..., max_rows}), so a
+model serves any small request with at most ~7 compiled programs.
+Padding rows are zeros and each row's traversal is independent, so the
+sliced output is bit-identical to the batch engine's (and therefore to
+``predict`` called directly) — asserted by tests/test_serve.py.
+
+This is the AOT variant of ISSUE's low-latency options; the
+``codegen.py`` tree-to-C route (now with an ``extern "C"`` batch ABI)
+remains the off-process alternative for environments without jax.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..obs.metrics import global_metrics
+from ..ops.predict import (_ARRAY_FIELDS, PackedEnsemble, _next_pow2,
+                           pack_ensemble, predict_raw_multiclass)
+
+# AOT warmup compiles are counted under this tag (the low-latency twin
+# of PREDICT_TRACE_TAG); steady-state stability is asserted through
+# global_metrics.recompiles(SERVE_LOWLAT_TAG)
+SERVE_LOWLAT_TAG = "serve/lowlat"
+
+
+class LowLatencyPredictor:
+    """Per-model AOT-compiled small-batch predictor.
+
+    Packs the ensemble once (exact shapes — a static serving model pays
+    no capacity headroom) and compiles one executable per
+    (row-bucket, feature-width) on first use. ``warm()`` precompiles
+    the whole bucket ladder so the first real request doesn't pay it.
+    """
+
+    def __init__(self, trees: List, num_tree_per_iteration: int = 1,
+                 max_rows: int = 64, average_output: bool = False):
+        self._trees = trees
+        self._k = max(int(num_tree_per_iteration), 1)
+        self.max_rows = max(int(max_rows), 1)
+        self._average_output = bool(average_output)
+        self._iterations = max(len(trees) // self._k, 1)
+        self._ens: PackedEnsemble = None
+        self._arrs: Tuple[jax.Array, ...] = ()
+        self._compiled: Dict[Tuple[int, int], object] = {}
+
+    # ------------------------------------------------------------------
+    def _ensure_packed(self) -> None:
+        if self._ens is None:
+            self._ens = pack_ensemble(self._trees, self._k)
+            self._arrs = tuple(getattr(self._ens, f) for f in _ARRAY_FIELDS)
+
+    @property
+    def nbytes(self) -> int:
+        """Device bytes held by the packed tensors (0 until first use)."""
+        return sum(a.nbytes for a in self._arrs)
+
+    def buckets(self) -> List[int]:
+        """The power-of-two row-bucket ladder up to max_rows."""
+        out = []
+        b = 1
+        while b < self.max_rows:
+            out.append(b)
+            b <<= 1
+        out.append(self.max_rows)
+        return out
+
+    def bucket(self, rows: int) -> int:
+        return min(_next_pow2(rows), self.max_rows) if rows else 1
+
+    def _program(self, rows_bucket: int, num_features: int):
+        key = (rows_bucket, num_features)
+        prog = self._compiled.get(key)
+        if prog is None:
+            ens = self._ens
+
+            def run(*args):
+                e = PackedEnsemble(
+                    *args[:-1], max_depth=ens.max_depth,
+                    num_trees_per_class=ens.num_trees_per_class,
+                    num_trees=ens.num_trees,
+                    has_categorical=ens.has_categorical)
+                return predict_raw_multiclass(e, args[-1])
+
+            shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                      for a in self._arrs]
+            shapes.append(jax.ShapeDtypeStruct(
+                (rows_bucket, num_features), jnp.float32))
+            prog = jax.jit(global_metrics.wrap_traced(SERVE_LOWLAT_TAG, run)
+                           ).lower(*shapes).compile()
+            self._compiled[key] = prog
+        return prog
+
+    def warm(self, num_features: int) -> int:
+        """Precompile every bucket for `num_features`-wide requests;
+        returns the number of executables now resident."""
+        self._ensure_packed()
+        for b in self.buckets():
+            self._program(b, num_features)
+        return len(self._compiled)
+
+    # ------------------------------------------------------------------
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        """Raw scores [B, K] float64 for B <= max_rows rows — the same
+        values predict_raw_cached produces for the same rows."""
+        x = np.asarray(data, np.float64)
+        if x.ndim == 1:
+            x = x.reshape(1, -1)
+        rows, f = x.shape
+        if rows > self.max_rows:
+            raise ValueError(f"low-latency path takes <= {self.max_rows} "
+                             f"rows, got {rows} (use the batched path)")
+        self._ensure_packed()
+        t0 = time.perf_counter()
+        b = self.bucket(rows)
+        xb = np.zeros((b, f), np.float32)
+        xb[:rows] = x
+        out = self._program(b, f)(*self._arrs, jnp.asarray(xb))
+        out = np.asarray(out, np.float64)[:rows]
+        if self._average_output:
+            out /= self._iterations
+        dt = time.perf_counter() - t0
+        global_metrics.note_predict(rows, dt)
+        global_metrics.note_latency(SERVE_LOWLAT_TAG, dt)
+        return out

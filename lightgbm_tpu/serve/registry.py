@@ -1,0 +1,186 @@
+"""Multi-tenant model registry with LRU pack eviction.
+
+A serving process holds many named models, but the expensive part of a
+resident model is not the host ``Tree`` list — it is the packed
+``[T, ...]`` ensemble tensors (host numpy in the ``EnsemblePacker`` +
+their device mirrors) and the AOT low-latency executables. The registry
+therefore evicts PACKS, not models: over the ``max_pack_bytes`` budget
+the least-recently-used model's packed tensors and compiled small-batch
+programs are dropped, while the host model stays loaded. The next
+request against an evicted model transparently re-packs (and pays the
+warmup compiles again) and — because packing is deterministic and the
+``(tree, pack_version)`` identity tokens are revalidated on every
+``EnsemblePacker.update`` — produces bit-identical predictions
+(asserted by tests/test_serve.py).
+
+Hit / miss / eviction counts are exported through the always-on
+``obs.metrics.global_metrics`` counters:
+
+- ``serve/registry_hit`` / ``serve/registry_miss``
+- ``serve/pack_evictions`` / ``serve/evicted_bytes``
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import List, Optional
+
+import numpy as np
+
+from ..obs.metrics import global_metrics
+from .lowlat import LowLatencyPredictor
+
+
+class ServedModel:
+    """One registry entry: a loaded model plus its serving state (the
+    lazily-built low-latency predictor). Create via ModelRegistry.load."""
+
+    def __init__(self, name: str, model, lowlat_max_rows: int = 64):
+        self.name = name
+        self.model = model  # model_io.LoadedModel
+        self.lowlat_max_rows = int(lowlat_max_rows)
+        self._lowlat: Optional[LowLatencyPredictor] = None
+        # linear-tree leaves predict on host (the engine has no linear
+        # path) — such models always route through predict_raw
+        self.supports_lowlat = not any(
+            getattr(t, "is_linear", False) for t in model.trees)
+
+    # -- prediction entries (raw [B, K] float64) -----------------------
+    def predict_raw(self, data: np.ndarray) -> np.ndarray:
+        """Full-model raw scores through the streaming engine — the
+        micro-batcher's dispatch function."""
+        return self.model.predict_raw(data)
+
+    def lowlat_predict(self, data: np.ndarray) -> np.ndarray:
+        """Raw scores through the AOT small-batch path (B <= 64-ish)."""
+        return self.lowlat(data)
+
+    @property
+    def lowlat(self) -> LowLatencyPredictor:
+        if self._lowlat is None:
+            self._lowlat = LowLatencyPredictor(
+                self.model.trees,
+                num_tree_per_iteration=self.model.num_tree_per_iteration,
+                max_rows=self.lowlat_max_rows,
+                average_output=self.model.average_output)
+        return self._lowlat
+
+    # -- pack accounting / eviction ------------------------------------
+    def pack_bytes(self) -> int:
+        """Resident packed-ensemble bytes for this model: host packer
+        arrays x2 (device tensors mirror the host shapes) plus the
+        low-latency path's device pack."""
+        total = 0
+        for packer in getattr(self.model, "_packers", {}).values():
+            total += 2 * packer.nbytes
+        if self._lowlat is not None:
+            total += self._lowlat.nbytes
+        return total
+
+    def drop_packs(self) -> int:
+        """Evict this model's packed tensors + AOT executables (the
+        model itself stays loaded). Returns the bytes released."""
+        released = self.pack_bytes()
+        self.model._packers = {}
+        self.model._packed = None
+        self.model._packed_key = None
+        self._lowlat = None
+        return released
+
+
+class ModelRegistry:
+    """Named-model store with LRU pack eviction under a byte budget.
+
+    ``get`` bumps the entry to most-recently-used; ``evict_to_budget``
+    walks from the LRU end dropping packs until the total is back under
+    ``max_pack_bytes`` (0 = unbounded). The most-recently-used entry is
+    never evicted — dropping the pack of the model a request just used
+    would re-pack it on every call.
+    """
+
+    def __init__(self, max_pack_bytes: int = 1 << 30,
+                 lowlat_max_rows: int = 64):
+        self.max_pack_bytes = int(max_pack_bytes)
+        self.lowlat_max_rows = int(lowlat_max_rows)
+        self._entries: "OrderedDict[str, ServedModel]" = OrderedDict()
+
+    # ------------------------------------------------------------------
+    def load(self, name: str, model=None, model_file: Optional[str] = None,
+             model_str: Optional[str] = None, booster=None) -> ServedModel:
+        """Register a model under `name` from exactly one source: an
+        already-parsed LoadedModel, a text-format file, a model string,
+        or a live Booster (snapshotted through its text serialization,
+        so later training on the booster can't mutate the served trees).
+        Re-loading an existing name replaces it (and frees its packs)."""
+        from ..model_io import load_model_from_string
+        sources = [s is not None for s in (model, model_file, model_str,
+                                           booster)]
+        if sum(sources) != 1:
+            raise ValueError("load() needs exactly one of model=, "
+                             "model_file=, model_str=, booster=")
+        if model_file is not None:
+            with open(model_file) as fh:
+                model = load_model_from_string(fh.read())
+        elif model_str is not None:
+            model = load_model_from_string(model_str)
+        elif booster is not None:
+            model = load_model_from_string(booster.model_to_string())
+        old = self._entries.pop(name, None)
+        if old is not None:
+            old.drop_packs()
+        entry = ServedModel(name, model, self.lowlat_max_rows)
+        self._entries[name] = entry
+        return entry
+
+    def get(self, name: str) -> ServedModel:
+        """Look up a model (counts a registry hit/miss, bumps to MRU)."""
+        entry = self._entries.get(name)
+        if entry is None:
+            global_metrics.inc_counter("serve/registry_miss")
+            raise KeyError(f"model '{name}' is not registered "
+                           f"(have: {sorted(self._entries)})")
+        global_metrics.inc_counter("serve/registry_hit")
+        self._entries.move_to_end(name)
+        return entry
+
+    def retire(self, name: str) -> bool:
+        """Unregister `name`, releasing its packs. False if unknown."""
+        entry = self._entries.pop(name, None)
+        if entry is None:
+            return False
+        entry.drop_packs()
+        return True
+
+    def names(self) -> List[str]:
+        return list(self._entries)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._entries
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    # ------------------------------------------------------------------
+    def pack_bytes(self) -> int:
+        """Total resident packed bytes across every registered model."""
+        return sum(e.pack_bytes() for e in self._entries.values())
+
+    def evict_to_budget(self) -> int:
+        """Drop LRU packs until under budget; returns models evicted.
+        O(models) when under budget — cheap enough to run per request."""
+        if self.max_pack_bytes <= 0:
+            return 0
+        total = self.pack_bytes()
+        evicted = 0
+        # LRU -> MRU order; the MRU entry is exempt (see class docstring)
+        for name in list(self._entries)[:-1]:
+            if total <= self.max_pack_bytes:
+                break
+            released = self._entries[name].drop_packs()
+            if released <= 0:
+                continue
+            total -= released
+            evicted += 1
+            global_metrics.inc_counter("serve/pack_evictions")
+            global_metrics.inc_counter("serve/evicted_bytes", released)
+        return evicted

@@ -1,0 +1,230 @@
+"""Async model server: size-routed request front over the registry.
+
+``ModelServer.predict(name, x)`` is the in-process serving API:
+
+- requests of <= ``lowlat_max_rows`` rows dispatch through the
+  AOT-compiled per-model low-latency path (no queueing, no deadline);
+- larger requests coalesce in a per-model ``MicroBatcher`` and ride
+  one engine dispatch into the warm shape buckets.
+
+Either way the bytes returned are identical to ``model.predict`` called
+directly (same engine math, same ``transform_raw``). Device work from
+both paths funnels through ONE single-thread executor — the serving
+analog of one accelerator queue: the event loop keeps accepting and
+coalescing requests while the device runs the previous batch.
+
+Per-request latency lands in the always-on ``obs.metrics`` reservoirs
+(``serve/request`` p50/p95/p99 via ``latency_summary``), request/row
+counts in the ``serve/*`` counters, and the registry's pack budget is
+re-enforced after every request.
+
+``serve_file`` is the thin driver behind ``python -m lightgbm_tpu
+serve``: it replays a data file through the server as concurrent
+requests and emits one summary JSON line.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs.metrics import global_metrics
+from .batcher import MicroBatcher
+from .registry import ModelRegistry, ServedModel
+
+# the default request-size cycle for file replay (serve_request_rows=0):
+# mostly low-latency-path sizes with periodic medium batches — the
+# mixed-traffic shape the scheduler exists for
+_MIXED_SIZES = (1, 8, 64, 512, 16, 2048, 32, 4)
+
+
+class ModelServer:
+    def __init__(self, registry: ModelRegistry,
+                 max_batch_rows: int = 8192, max_wait_ms: float = 2.0,
+                 lowlat_max_rows: Optional[int] = None):
+        self.registry = registry
+        self.max_batch_rows = int(max_batch_rows)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.lowlat_max_rows = int(registry.lowlat_max_rows
+                                   if lowlat_max_rows is None
+                                   else lowlat_max_rows)
+        # one device queue: batched AND low-latency dispatches serialize
+        # here while the event loop keeps coalescing the next batch
+        self._executor = ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="lgbm-serve")
+        self._batchers: Dict[str, MicroBatcher] = {}
+
+    # ------------------------------------------------------------------
+    def _batcher(self, entry: ServedModel) -> MicroBatcher:
+        b = self._batchers.get(entry.name)
+        if b is None or b._predict_fn.__self__ is not entry:
+            # new or re-loaded entry: bind a fresh batcher to it
+            b = self._batchers[entry.name] = MicroBatcher(
+                entry.predict_raw, max_batch_rows=self.max_batch_rows,
+                max_wait_s=self.max_wait_s, executor=self._executor)
+        return b
+
+    async def predict(self, name: str, data, raw_score: bool = False
+                      ) -> np.ndarray:
+        """Serve one request against model `name`. Output shape/values
+        match ``LoadedModel.predict(data, raw_score=raw_score)``."""
+        t0 = time.perf_counter()
+        x = np.asarray(data, np.float64)
+        if x.ndim == 1:
+            x = x.reshape(1, -1)
+        entry = self.registry.get(name)
+        need = entry.model.max_feature_idx + 1
+        if x.shape[1] != need:
+            # the engine's flat feature gathers CLAMP out-of-range
+            # indices — a silent wrong answer; reject up front (the CLI
+            # replay pads/truncates via conform_prediction_data first)
+            raise ValueError(
+                f"request has {x.shape[1]} features but model "
+                f"'{name}' expects {need}")
+        loop = asyncio.get_running_loop()
+        # a server-level threshold can only lower the routing cut below
+        # the per-entry AOT limit, never push requests past it
+        lowlat_cap = min(self.lowlat_max_rows, entry.lowlat_max_rows)
+        if x.shape[0] <= lowlat_cap and entry.supports_lowlat:
+            global_metrics.inc_counter("serve/lowlat_requests")
+            raw = await loop.run_in_executor(
+                self._executor, entry.lowlat_predict, x)
+        else:
+            global_metrics.inc_counter("serve/batched_requests")
+            raw = await self._batcher(entry).submit(x)
+        out = raw[:, 0] if raw.shape[1] == 1 else raw
+        if not raw_score:
+            from ..model_io import transform_raw
+            out = transform_raw(entry.model.objective_str, out)
+        global_metrics.inc_counter("serve/requests")
+        global_metrics.inc_counter("serve/rows", x.shape[0])
+        global_metrics.note_latency("serve/request",
+                                    time.perf_counter() - t0)
+        self.registry.evict_to_budget()
+        return out
+
+    # ------------------------------------------------------------------
+    def warm(self, name: str, num_features: int) -> None:
+        """Precompile the serving program set for `name`: the low-
+        latency bucket ladder plus the engine's power-of-two batch
+        buckets up to max_batch_rows. After this, steady-state traffic
+        of any request mix runs with ZERO recompiles (asserted by
+        tools/check_serve.py through the obs recompile counters)."""
+        entry = self.registry.get(name)
+        if entry.supports_lowlat:
+            entry.lowlat.warm(num_features)
+        b = 16  # engine buckets floor at 16 rows (ops/predict._row_bucket)
+        while b < 2 * self.max_batch_rows:
+            entry.predict_raw(np.zeros((b, num_features)))
+            b <<= 1
+
+    def stats(self) -> Dict:
+        """Serving snapshot: request latency quantiles + counters."""
+        return {
+            "request_latency": global_metrics.latency_summary(
+                "serve/request"),
+            "batch_wait": global_metrics.latency_summary(
+                "serve/batch_wait"),
+            "counters": {k: v for k, v in
+                         sorted(global_metrics.counters.items())
+                         if k.startswith("serve/")},
+            "pack_bytes": self.registry.pack_bytes(),
+        }
+
+    async def close(self) -> None:
+        """Flush pending batches and release the device executor."""
+        for b in self._batchers.values():
+            b.flush()
+        self._executor.shutdown(wait=True)
+
+
+# ----------------------------------------------------------------------
+async def replay(server: ModelServer, name: str, data: np.ndarray,
+                 sizes: Sequence[int], raw_score: bool = False,
+                 arrival_s: Optional[Sequence[float]] = None
+                 ) -> List[np.ndarray]:
+    """Fire one request per entry of `sizes`, slicing `data` in order,
+    all concurrently; returns the per-request outputs in request order.
+    With `arrival_s`, request i is released at that offset from the
+    replay start (an OPEN-loop trace: arrivals don't wait for earlier
+    completions — queueing delay shows up in the latency quantiles
+    instead of silently throttling the offered load)."""
+    async def one(lo: int, hi: int, delay: float) -> np.ndarray:
+        if delay > 0:
+            await asyncio.sleep(delay)
+        return await server.predict(name, data[lo:hi],
+                                    raw_score=raw_score)
+
+    tasks = []
+    lo = 0
+    for i, size in enumerate(sizes):
+        hi = min(lo + int(size), data.shape[0])
+        delay = float(arrival_s[i]) if arrival_s is not None else 0.0
+        tasks.append(asyncio.ensure_future(one(lo, hi, delay)))
+        lo = hi
+        if lo >= data.shape[0]:
+            break
+    return list(await asyncio.gather(*tasks))
+
+
+def request_sizes(total_rows: int, request_rows: int = 0) -> List[int]:
+    """Split `total_rows` into request sizes: fixed `request_rows`, or
+    the mixed small/large cycle when 0."""
+    sizes: List[int] = []
+    done = 0
+    i = 0
+    while done < total_rows:
+        s = request_rows if request_rows > 0 else \
+            _MIXED_SIZES[i % len(_MIXED_SIZES)]
+        sizes.append(min(s, total_rows - done))
+        done += sizes[-1]
+        i += 1
+    return sizes
+
+
+def serve_file(input_model: str, data_path: str, output_result: str,
+               params: Optional[Dict] = None) -> Dict:
+    """The ``task=serve`` driver: load the model into a registry,
+    replay the data file through the async server as concurrent
+    requests, write predictions (in row order) to `output_result`, and
+    return the serving stats dict. `params` carries the serve_* knobs
+    plus loader options."""
+    from ..cli import conform_prediction_data, write_prediction_file
+    from ..config import Config
+    from ..io.text_loader import load_svmlight_or_csv
+    cfg = Config.from_params(params or {})
+    data, _label, _w, _g = load_svmlight_or_csv(data_path,
+                                                dict(params or {}))
+    registry = ModelRegistry(max_pack_bytes=cfg.serve_cache_bytes,
+                             lowlat_max_rows=cfg.serve_lowlat_max_rows)
+    entry = registry.load("default", model_file=input_model)
+    data = conform_prediction_data(np.asarray(data, np.float64),
+                                   entry.model.max_feature_idx + 1,
+                                   cfg.predict_disable_shape_check)
+    server = ModelServer(registry,
+                         max_batch_rows=cfg.serve_max_batch_rows,
+                         max_wait_ms=cfg.serve_max_wait_ms)
+    sizes = request_sizes(data.shape[0], cfg.serve_request_rows)
+
+    async def run() -> List[np.ndarray]:
+        try:
+            return await replay(server, "default", data, sizes,
+                                raw_score=cfg.predict_raw_score)
+        finally:
+            await server.close()
+
+    t0 = time.perf_counter()
+    outs = asyncio.run(run())
+    elapsed = time.perf_counter() - t0
+
+    write_prediction_file(output_result, outs)
+
+    stats = server.stats()
+    stats.update(requests=len(outs), rows=int(data.shape[0]),
+                 seconds=round(elapsed, 4),
+                 rows_per_sec=round(data.shape[0] / max(elapsed, 1e-9), 1))
+    return stats

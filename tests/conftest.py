@@ -29,7 +29,7 @@ QUICK_FILES = {
     "test_native.py", "test_param_honesty.py", "test_objectives.py",
     "test_metrics.py", "test_model_io.py", "test_learner.py",
     "test_booster_surface.py", "test_ingestion.py", "test_waved.py",
-    "test_predict_engine.py",
+    "test_predict_engine.py", "test_serve.py", "test_codegen.py",
 }
 
 

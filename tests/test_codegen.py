@@ -1,0 +1,171 @@
+"""Codegen oracle tests (codegen.py model_to_if_else).
+
+The emitted standalone C++ must route every row to the SAME leaf as the
+tree-parallel device engine (ops/predict.py) — per tree, exactly —
+including categorical bitset splits and all three missing-value types.
+The serve/ low-latency path and the C++ route are the two small-batch
+serving options, so they must agree on decision semantics.
+
+Strictness tiers:
+- per-tree: C++ ``PredictTreeRows`` raw leaf outputs (f64) vs the
+  engine's leaf INDICES gathered into the host f64 leaf values —
+  bit-exact equality (leaf routing has no rounding once inputs are
+  f32-representable, which the test data is by construction).
+- aggregate: C++ ``PredictRows`` accumulates in f64, the packed device
+  ensemble in f32 — agreement at f32 resolution.
+"""
+
+import ctypes
+import shutil
+import subprocess
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.codegen import model_to_if_else
+from lightgbm_tpu.model_io import load_model_from_string
+from lightgbm_tpu.ops.predict import pack_ensemble, predict_leaf_index
+
+pytestmark = [
+    pytest.mark.quick,
+    pytest.mark.skipif(shutil.which("g++") is None,
+                       reason="g++ not available"),
+]
+
+
+def _data(n=300, f=8, seed=0, nans=False, zeros=False, cats=False):
+    rng = np.random.RandomState(seed)
+    # f32-representable values: the engine compares in f32, the C++ in
+    # f64 — exactly-representable inputs make leaf routing identical
+    x = rng.randn(n, f).astype(np.float32).astype(np.float64)
+    if cats:
+        x[:, 0] = rng.randint(0, 12, n)
+        x[:, 1] = rng.randint(0, 5, n)
+    if nans:
+        x[::7, 2] = np.nan
+    if zeros:
+        x[::5, 3] = 0.0
+    y = ((np.nan_to_num(x[:, 2]) + x[:, 4]
+          + (x[:, 0] % 3 == 1) * 2.0 + (x[:, 1] == 2) * 1.5)
+         > 1.0).astype(np.float64)
+    return x, y
+
+
+def _loaded(x, y, extra=None, rounds=5, categorical=None):
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    params.update(extra or {})
+    ds = lgb.Dataset(x, label=y, params=params,
+                     categorical_feature=categorical or "auto")
+    bst = lgb.train(params, ds, num_boost_round=rounds)
+    return load_model_from_string(bst.model_to_string())
+
+
+def _compile(tmp_path, model) -> ctypes.CDLL:
+    src = model_to_if_else(model, extern_c=True)
+    cpp = tmp_path / "pred.cpp"
+    cpp.write_text(src)
+    so = tmp_path / "pred.so"
+    # -O0: parity is optimization-independent and compile time is the
+    # dominant test cost (test_cli.py keeps an -O2 compile)
+    subprocess.run(["g++", "-O0", "-shared", "-fPIC", str(cpp),
+                    "-o", str(so)], check=True)
+    lib = ctypes.CDLL(str(so))
+    dptr = ctypes.POINTER(ctypes.c_double)
+    lib.PredictRows.argtypes = [dptr, ctypes.c_longlong,
+                                ctypes.c_longlong, dptr]
+    lib.PredictTreeRows.argtypes = [ctypes.c_longlong, dptr,
+                                    ctypes.c_longlong, ctypes.c_longlong,
+                                    dptr]
+    lib.GetNumClass.restype = ctypes.c_longlong
+    lib.GetNumTrees.restype = ctypes.c_longlong
+    return lib
+
+
+def _dptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def _cpp_rows(lib, x, k=1):
+    x = np.ascontiguousarray(x)
+    out = np.zeros((x.shape[0], k))
+    lib.PredictRows(_dptr(x), x.shape[0], x.shape[1], _dptr(out))
+    return out[:, 0] if k == 1 else out
+
+
+def _cpp_tree(lib, tree_idx, x):
+    x = np.ascontiguousarray(x)
+    out = np.zeros(x.shape[0])
+    lib.PredictTreeRows(tree_idx, _dptr(x), x.shape[0], x.shape[1],
+                        _dptr(out))
+    return out
+
+
+def _assert_pertree_parity(lib, model, x):
+    """Every tree, every row: C++ leaf output == the engine's routed
+    leaf's (host f64) value, bit-exact."""
+    ens = pack_ensemble(model.trees, max(model.num_tree_per_iteration, 1))
+    leaves = np.asarray(predict_leaf_index(ens, jnp.asarray(x, jnp.float32)))
+    for i, tree in enumerate(model.trees):
+        want = tree.leaf_value[leaves[:, i]]
+        np.testing.assert_array_equal(
+            _cpp_tree(lib, i, x), want,
+            err_msg=f"tree {i} routed differently in C++ vs engine")
+
+
+@pytest.mark.parametrize("variant", ["missing_none", "missing_nan",
+                                     "missing_zero"])
+def test_pertree_parity_all_missing_types(tmp_path, variant):
+    x, y = _data(nans=variant == "missing_nan",
+                 zeros=variant == "missing_zero")
+    extra = {}
+    if variant == "missing_zero":
+        extra["zero_as_missing"] = True
+    elif variant == "missing_none":
+        extra["use_missing"] = False
+    model = _loaded(x, y, extra)
+    lib = _compile(tmp_path, model)
+    assert lib.GetNumTrees() == len(model.trees)
+    _assert_pertree_parity(lib, model, x)
+
+
+def test_pertree_parity_categorical(tmp_path):
+    x, y = _data(cats=True, nans=True)
+    model = _loaded(x, y, {"min_data_per_group": 2, "cat_smooth": 1.0},
+                    categorical=[0, 1])
+    assert any(t.num_cat > 0 for t in model.trees), "no categorical splits"
+    lib = _compile(tmp_path, model)
+    _assert_pertree_parity(lib, model, x)
+    # unseen / out-of-range category values must also agree (bitset
+    # range check vs the engine's in_range mask)
+    xq = x.copy()
+    xq[:40, 0] = np.asarray([99, 1e6, -3, 31, 32, 63, 64, 12] * 5)
+    _assert_pertree_parity(lib, model, xq)
+
+
+def test_aggregate_matches_engine_binary(tmp_path):
+    x, y = _data(nans=True, zeros=True)
+    model = _loaded(x, y)
+    lib = _compile(tmp_path, model)
+    got = _cpp_rows(lib, x)
+    want = model.predict(x, raw_score=True)
+    # C++ sums in f64, the packed device ensemble in f32: agreement is
+    # at f32 resolution, not bitwise (same contract as test_cli.py)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+
+
+def test_aggregate_matches_engine_multiclass(tmp_path):
+    x, _ = _data(n=400)
+    rng = np.random.RandomState(3)
+    y = rng.randint(0, 3, 400).astype(np.float64)
+    model = _loaded(x, y, {"objective": "multiclass", "num_class": 3,
+                           "num_leaves": 7}, rounds=4)
+    lib = _compile(tmp_path, model)
+    assert lib.GetNumClass() == 3
+    got = _cpp_rows(lib, x, k=3)
+    want = model.predict(x, raw_score=True)
+    assert want.shape == (400, 3)
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
+    _assert_pertree_parity(lib, model, x)

@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""CI smoke for the serving subsystem (serve/).
+
+Trains a small model, starts the in-process async server, warms the
+serving program set, then fires 200 mixed-size concurrent requests
+(B=1..64 low-latency path interleaved with medium coalesced batches)
+and asserts:
+
+1. every response is BIT-identical to calling `predict` directly on
+   that request's rows, and
+2. ZERO steady-state recompiles after warmup, on both the engine
+   traversal tag and the AOT low-latency tag, via the always-on
+   obs.metrics recompile counters.
+
+Exit 0 = pass. Usage: python tools/check_serve.py
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.ops.predict import PREDICT_TRACE_TAG
+    from lightgbm_tpu.serve import (ModelRegistry, ModelServer,
+                                    SERVE_LOWLAT_TAG)
+    from lightgbm_tpu.serve.server import replay
+
+    rng = np.random.RandomState(0)
+    n, f = 1200, 10
+    x = rng.randn(n, f)
+    x[::7, 2] = np.nan
+    y = ((np.nan_to_num(x[:, 2]) + x[:, 4]) > 0.5).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(x, label=y, params=params),
+                    num_boost_round=10)
+
+    registry = ModelRegistry()
+    registry.load("smoke", booster=bst)
+    direct = registry.get("smoke").model
+    server = ModelServer(registry, max_batch_rows=2048, max_wait_ms=1.0)
+    server.warm("smoke", f)
+
+    warm_lowlat = global_metrics.recompiles(SERVE_LOWLAT_TAG)
+    warm_traversal = global_metrics.recompiles(PREDICT_TRACE_TAG)
+
+    # 200 mixed-size requests: the small/medium cycle repeated
+    cycle = (1, 3, 8, 17, 40, 64, 2, 130, 31, 257, 5, 700, 16, 64,
+             1, 1000, 23, 90, 11, 512)
+    sizes = [cycle[i % len(cycle)] for i in range(200)]
+    xt = rng.randn(sum(sizes), f)
+    xt[::9, 2] = np.nan
+
+    async def run():
+        try:
+            return await replay(server, "smoke", xt, sizes,
+                                raw_score=True)
+        finally:
+            await server.close()
+
+    t0 = time.perf_counter()
+    outs = asyncio.run(run())
+    elapsed = time.perf_counter() - t0
+
+    failures = 0
+    lo = 0
+    for i, (s, out) in enumerate(zip(sizes, outs)):
+        hi = lo + s
+        want = direct.predict(xt[lo:hi], raw_score=True)
+        if not np.array_equal(out, want):
+            print(f"FAIL: request {i} ({s} rows) != direct predict "
+                  f"(max abs diff {np.abs(out - want).max():g})")
+            failures += 1
+        lo = hi
+
+    d_lowlat = global_metrics.recompiles(SERVE_LOWLAT_TAG) - warm_lowlat
+    d_traversal = (global_metrics.recompiles(PREDICT_TRACE_TAG)
+                   - warm_traversal)
+    if d_lowlat or d_traversal:
+        print(f"FAIL: steady-state recompiles (lowlat={d_lowlat}, "
+              f"traversal={d_traversal}) — the warm bucket set leaked")
+        failures += 1
+
+    lat = global_metrics.latency_summary("serve/request")
+    counters = {k: v for k, v in sorted(global_metrics.counters.items())
+                if k.startswith("serve/")}
+    print(f"served {len(outs)} requests ({lo} rows) in {elapsed:.2f}s "
+          f"({lo / elapsed:.0f} rows/s); p50={lat['p50_ms']:.2f}ms "
+          f"p99={lat['p99_ms']:.2f}ms; counters={counters}")
+    if failures:
+        print(f"check_serve: {failures} failure(s)")
+        return 1
+    print("check_serve: OK (bit-parity on 200 mixed requests, "
+          "zero steady-state recompiles)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
