@@ -339,6 +339,22 @@ def _measure():
         "unit": unit,
         "vs_baseline": round(iters_per_sec / BASELINE_IPS, 4),
     }
+    # histogram HBM traffic counters (always-on obs meta, set by the
+    # grower build): the driver-visible side of ROADMAP item 3 — bytes
+    # per iteration under the active encodings (bin packing, gh
+    # encoding, fused gradient pass, subtraction-aware wave schedule)
+    # vs the unpacked/no-subtraction oracle. Checked by
+    # tools/check_perf_gate.py.
+    from lightgbm_tpu.obs.metrics import global_metrics
+    ht = global_metrics.meta.get("hist_traffic")
+    if ht:
+        result["hist_bytes_per_iter"] = ht["hist_bytes_per_iter"]
+        result["hist_rows_scanned_per_iter"] = ht["rows_scanned_per_iter"]
+        result["hist_passes_per_iter"] = ht["passes"]
+        result["hist_bytes_oracle_per_iter"] = global_metrics.meta[
+            "hist_traffic_oracle"]["hist_bytes_per_iter"]
+        result["hist_bytes_reduction"] = global_metrics.meta[
+            "hist_bytes_reduction"]
     if telemetry:
         # fold the phase-time summary into the one JSON line instead of
         # leaving it buried in raw stderr

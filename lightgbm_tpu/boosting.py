@@ -100,8 +100,18 @@ class GBDT:
         if objective is not None:
             objective.init(train_set.metadata, self.num_data)
 
-        # device-side constants
-        self.bins_fm = train_set.device_bins()
+        # device-side constants. Bit-packed bin storage (tpu_bin_pack,
+        # ops/bin_pack.py): when the bin-id range fits 4-bit nibbles the
+        # device tensor ships packed and every histogram/partition
+        # consumer unpacks on the fly — the packed bytes are what each
+        # of the ~13 per-iteration full-data passes actually reads.
+        self._bin_pack_vpb = 1
+        packed = self._maybe_pack_bins(train_set)
+        if packed is not None:
+            self.bins_fm = packed
+            self._bin_pack_vpb = packed.vpb
+        else:
+            self.bins_fm = train_set.device_bins()
         # EFB (ref: dataset.cpp:251): bins_fm is bundled [G, N] storage;
         # the growers decode through this triple (None when unbundled)
         self._bundle = train_set.device_bundle()
@@ -214,6 +224,22 @@ class GBDT:
         self._valid_sets: List = []
         self._valid_scores: List[np.ndarray] = []
 
+    def _maybe_pack_bins(self, binned):
+        """Bit-packed device bins for `binned`, or None when ineligible
+        (knob off, bins too wide, EFB/COO storage, or a sharded layout —
+        the mesh paths shard raw rows)."""
+        cfg = self.config
+        if str(cfg.tpu_bin_pack) in ("off", "0", "false", "False"):
+            return None
+        if cfg.tree_learner != "serial" or int(cfg.tpu_num_shards or 0) > 1:
+            return None
+        if binned.sparse_coo is not None or binned.bundle_info is not None:
+            return None
+        from .ops import bin_pack as bp
+        host = bp.pack_bins_host(np.asarray(binned.bins_fm),
+                                 int(binned.max_bins))
+        return bp.to_device(host) if host is not None else None
+
     def _parse_forced_splits(self):
         """forcedsplits_filename JSON -> (leaf, feature, threshold_bin)
         int32 arrays aligned with scan steps, or None
@@ -283,6 +309,10 @@ class GBDT:
         return out
 
     def _build_grow(self, hist_impl: str, shard_mesh=None) -> None:
+        if self.config.deterministic_hist:
+            # Kahan-compensated accumulation lives on the XLA path; the
+            # pallas kernels keep their own (non-compensated) order
+            hist_impl = "xla"
         self._hist_impl = hist_impl
         self._shard_mesh = shard_mesh
         self._has_categorical = any(
@@ -292,11 +322,68 @@ class GBDT:
         self._use_node_rand = (self.config.extra_trees or
                                self.config.feature_fraction_bynode < 1.0)
         self._extra_key = jax.random.PRNGKey(self.config.extra_seed)
+        self._fused_grad_fn = self._resolve_fused_grad()
         self._grow = jax.jit(global_metrics.wrap_traced(
             "boosting/grow", self._grow_partial()))
         self._fused = None
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
+        self._note_hist_traffic()
+
+    def _resolve_fused_grad(self):
+        """The objective's pointwise gradient fn when the fused
+        gradient/histogram wave applies (tpu_fused_grad), else None.
+        Requires the waved single-output path with plain pre-computed
+        sampling: GOSS reweights by |g| and quantization re-encodes gh,
+        so both keep the materialized-gradient path."""
+        cfg = self.config
+        if str(cfg.tpu_fused_grad) in ("off", "0", "false", "False"):
+            return None
+        if not self._use_waved() or self.num_tree_per_iteration != 1:
+            return None
+        if self._quant_enabled or cfg.data_sample_strategy == "goss":
+            return None
+        if self._sparse_shape is not None or self.objective is None:
+            return None
+        return self.objective.pointwise_grad_fn()
+
+    def _note_hist_traffic(self) -> None:
+        """Publish the static per-iteration histogram traffic model (and
+        its unpacked / no-subtraction / unfused oracle) through
+        obs.metrics — always-on meta, folded into bench.py's JSON line
+        and checked by tools/check_perf_gate.py."""
+        if self._sparse_shape is not None:
+            return
+        from .learner import hist_traffic_model
+        waved = self._use_waved()
+        quant_int8 = (self._quant_enabled and waved and
+                      int(self.config.num_grad_quant_bins) <= 126)
+        kw = dict(
+            num_data=int(self.num_data),
+            storage_features=int(self.train_set.bins_fm.shape[0]),
+            max_bins=int(self._num_bundle_bins
+                         or self._static["max_bins"]),
+            num_leaves=self._static["num_leaves"],
+            wave_max=max(self._resolved_wave_max(), 1),
+            waved=waved,
+        )
+        actual = hist_traffic_model(
+            **kw, pack_vpb=self._bin_pack_vpb,
+            gh_read_bytes=3 if quant_int8 else 12,
+            subtract=bool(self.config.tpu_wave_subtract),
+            fused_grad=self._fused_grad_fn is not None)
+        # oracle: unpacked f32 ghT, standalone gradient pass, and the
+        # non-subtraction-aware schedule (both children built per split)
+        oracle = hist_traffic_model(**kw, pack_vpb=1, gh_read_bytes=12,
+                                    subtract=False, fused_grad=False)
+        global_metrics.set_meta("hist_traffic", actual)
+        global_metrics.set_meta("hist_traffic_oracle", oracle)
+        global_metrics.set_meta("hist_bytes_per_iter",
+                                actual["hist_bytes_per_iter"])
+        global_metrics.set_meta(
+            "hist_bytes_reduction",
+            round(oracle["hist_bytes_per_iter"]
+                  / max(actual["hist_bytes_per_iter"], 1), 4))
 
     def _resolved_wave_max(self) -> int:
         """tpu_wave_max with -1 (auto) resolved: exact order for softmax
@@ -325,11 +412,13 @@ class GBDT:
         kw = dict(self._static)
         if self._use_waved():
             kw["wave_max"] = self._resolved_wave_max()
+            kw["subtract_siblings"] = bool(self.config.tpu_wave_subtract)
         if self._bundle is not None:
             kw["bundle"] = self._bundle
             kw["num_bundle_bins"] = self._num_bundle_bins
         if self._sparse_shape is not None:
             kw["sparse_shape"] = self._sparse_shape
+        kw["hist_deterministic"] = bool(self.config.deterministic_hist)
         return kw
 
     # ------------------------------------------------------------------
@@ -520,6 +609,14 @@ class GBDT:
             # int8 cast is exact only for bins <= 126 — larger
             # settings stay on the f32 hist path
             grow_kw["quant"] = quant
+        if grad is None:
+            # fused gradient/histogram wave (tpu_fused_grad): the
+            # caller skipped _grad_fn entirely; the grower derives
+            # gh from the objective's pointwise formula — in-kernel
+            # on the pallas path
+            grow_kw["fused_grad"] = (self._fused_grad_fn,
+                                     self.objective.label,
+                                     self.objective.weight, scores_k)
         rec, row_leaf = grow(bins_fm, grad, hess, mask, fmask,
                              self.feature_meta, self.hp,
                              self.max_depth, self._forced,
@@ -554,7 +651,13 @@ class GBDT:
                 key = jax.random.fold_in(self._bagging_key, it)
                 sample_mask = self._sampling_in_jit(
                     jax.random.fold_in(key, 1), it, sample_mask)
-                grad_all, hess_all = self._grad_fn(scores)
+                if self._fused_grad_fn is not None:
+                    # gradients fold into the histogram waves (see
+                    # _grow_class_traced) — no [N] gradient buffers in
+                    # this program at all
+                    grad_all = hess_all = (None,)
+                else:
+                    grad_all, hess_all = self._grad_fn(scores)
                 recs = []
                 new_valid = list(valid_scores)
                 for k in range(self.num_tree_per_iteration):
@@ -966,7 +1069,10 @@ class GBDT:
             score += (init.reshape(-1, n) if init.size != n
                       else init.reshape(1, n)).astype(np.float32)
         self._valid_scores.append(jnp.asarray(score))
-        self._valid_bins.append(valid_set.device_bins())
+        vbins = (self._maybe_pack_bins(valid_set)
+                 if self._bin_pack_vpb > 1 else None)
+        self._valid_bins.append(vbins if vbins is not None
+                                else valid_set.device_bins())
         self._fused = None  # fused program must include the new valid set
 
     def _valid_raw(self, i: int) -> np.ndarray:

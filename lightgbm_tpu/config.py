@@ -174,6 +174,10 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_hist_precision": [],
     "tpu_hist_impl": [],
     "tpu_sparse_hist": [],
+    "tpu_bin_pack": ["bin_pack"],
+    "tpu_fused_grad": ["fused_grad"],
+    "tpu_wave_subtract": [],
+    "deterministic_hist": ["tpu_deterministic_hist"],
     "tpu_dart_fused_max_bytes": [],
     "tpu_predict_chunk": ["predict_chunk", "predict_chunk_rows"],
     # serving knobs (serve/ subsystem)
@@ -486,6 +490,44 @@ class Config:
     # the estimated O(nnz) segment-sum work beats the dense/EFB layout,
     # "force"/"off" override. Serial tree learner only.
     tpu_sparse_hist: str = "auto"
+    # bit-packed bin storage (ops/bin_pack.py): "auto" packs the device
+    # bin tensor to 4-bit nibbles when max_bin <= 15 (2-bit pairs when
+    # <= 3), halving/quartering the dominant per-pass bin read of the
+    # cost model; "off" keeps the uint8 layout (the parity oracle —
+    # packed histogram + partition outputs are bit-identical to it on
+    # integer-valued gradients, tests/test_bin_pack.py). Dense unbundled
+    # serial storage only; EFB/COO/mesh layouts stay unpacked.
+    tpu_bin_pack: str = "auto"
+    # fuse the gradient/bagging element-wise pass into the histogram
+    # waves: the objective's pointwise gradient (objectives.
+    # pointwise_grad_fn — binary, L2 regression) is evaluated inside the
+    # waved grower — and, on the pallas path, inside the multi-leaf
+    # KERNEL itself, so the [N, 3] ghT operand never round-trips through
+    # HBM (~0.5 GB/iter at Higgs shape). "auto" = on whenever the
+    # objective supports it on the waved single-output fast path (no
+    # GOSS, no quantized gradients); "on" forces it (XLA path included —
+    # bitwise-identical gradients by construction); "off" disables.
+    # The in-kernel histogram accumulation order matches the unfused
+    # kernel exactly; only derived root-sum reductions are subject to
+    # normal f32 reduction-order tolerance.
+    tpu_fused_grad: str = "auto"
+    # sibling histograms by subtraction (build the smaller child, derive
+    # the larger from the pooled parent — serial_tree_learner.cpp:582),
+    # with the wave schedule packing ONE slot per split. False = the
+    # no-subtraction oracle: both children built directly, two slots
+    # per split, ~17 instead of ~13 full-data passes at 255 leaves.
+    # Documented tolerance: subtraction reorders f32 accumulation
+    # (parent - small vs direct build), so the two modes agree to
+    # normal cancellation tolerance, not bitwise. The obs
+    # `hist_traffic` counters report both cost models.
+    tpu_wave_subtract: bool = True
+    # opt-in deterministic histogram accumulation (ROADMAP item 4's
+    # numeric-parity debt): forces the XLA histogram path with
+    # fixed-size chunking and Kahan-compensated cross-chunk sums, so
+    # results are insensitive (to ~1 ulp) to chunking and to how
+    # sharding regroups rows. Costs the pallas kernel's bandwidth
+    # advantage — a parity/debug mode, not the perf path.
+    deterministic_hist: bool = False
     # DART fused-path budget: the per-tree leaf-assignment history
     # ([T, K, N] device buffer that lets dropped-tree contributions be
     # recomputed without host round-trips) is only kept below this many

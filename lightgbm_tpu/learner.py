@@ -283,7 +283,8 @@ def grow_tree(bins_fm: jax.Array,
               num_bundle_bins: int = 0,
               mono_pairwise: bool = False,
               shard_mesh=None,
-              sparse_shape=None):
+              sparse_shape=None,
+              hist_deterministic: bool = False):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
 
     sparse_shape: static (num_features, num_data) when bins_fm is a
@@ -335,7 +336,8 @@ def grow_tree(bins_fm: jax.Array,
     else:
         raw_build = functools.partial(
             hist_ops.build_histogram, max_bins=build_bins, dtype=f32,
-            row_chunk=row_chunk, impl=hist_impl, precision=hist_precision)
+            row_chunk=row_chunk, impl=hist_impl, precision=hist_precision,
+            deterministic=hist_deterministic)
     if sparse_shape is not None:
         pass  # build already set
     elif bundle is None:
@@ -631,29 +633,97 @@ def grow_tree(bins_fm: jax.Array,
     return tree_arrays, state.row_leaf
 
 
-def _wave_schedule(num_leaves: int, wave_max: int, slots: int):
+def _wave_schedule(num_leaves: int, wave_max: int, slots: int,
+                   slots_per_split: int = 1):
     """Static split-batch sizes: 1, 2, 4, ... doubling, capped at
-    min(max(8, splits_done // 2), wave_max, slots), summing to
-    num_leaves - 1.
+    min(max(8, splits_done // 2), wave_max, slots // slots_per_split),
+    summing to num_leaves - 1.
 
     The frontier-proportional cap (a wave never splits more than ~half
     the leaves the tree currently has) keeps the split ORDER close to
     exact leaf-wise where it matters: early high-impact splits are
-    near-exact, late waves batch up to `slots` splits per histogram
+    near-exact, late waves batch up to the slot cap per histogram
     pass. Measured on held-out data this matches the exact grower's
     quality (AUC +-0.002 at 63 and 255 leaves) while cutting full-data
     histogram passes from num_leaves-1 to ~13 at 255 leaves; fixed caps
-    either lose quality (32: -0.01 AUC) or passes (8: 34)."""
+    either lose quality (32: -0.01 AUC) or passes (8: 34).
+
+    slots_per_split makes the schedule SUBTRACTION-AWARE: with sibling
+    subtraction each split consumes ONE of the multi-kernel's 42 slots
+    (build the smaller child, derive the larger from the parent), so a
+    wave packs up to 42 splits per full-data pass; without it (the
+    oracle mode `tpu_wave_subtract=False`) every split needs TWO slots
+    and late waves halve — 17 passes instead of 13 at 255 leaves, and
+    every wave scans the rows of both children instead of only the
+    smaller one (<= half a skewed split's rows). The A/B is what the
+    obs `hist_traffic` counters and bench.py's JSON line report."""
     sizes, total, w = [], num_leaves - 1, 1
     done = 0
     while total > 0:
-        cap = min(max(8, done // 2), max(wave_max, 1), slots)
+        cap = min(max(8, done // 2), max(wave_max, 1),
+                  max(slots // slots_per_split, 1))
         s = min(w, total, cap)
         sizes.append(s)
         total -= s
         done += s
         w *= 2
     return sizes
+
+
+def hist_traffic_model(*, num_data: int, storage_features: int,
+                       max_bins: int, num_leaves: int, wave_max: int,
+                       slots: int = 42, pack_vpb=None,
+                       gh_read_bytes: int = 12, row_leaf_bytes: int = 4,
+                       subtract: bool = True, fused_grad: bool = False,
+                       waved: bool = True):
+    """Static per-iteration HBM traffic model of the histogram passes —
+    the driver-visible counter behind ROADMAP item 3 (the shapes, wave
+    schedule, packing factor and gh encoding are all trace-time
+    constants, so the model is exact for what the compiled program
+    streams; only gather inefficiency is outside it).
+
+    Per pass: the bin tensor read (``storage_features x ceil(N/vpb)``
+    bytes — halved by 4-bit packing), the gh operand read
+    (12 B/row f32 ghT, 3 B/row int8 quantized, 12 B/row
+    score+label+mask when the gradient pass is fused in-kernel) and the
+    row->leaf read. ``fused_grad`` additionally drops the standalone
+    gradient/bagging element-wise pass (read score/label/mask + write
+    ghT ~= 24 B/row once per iteration).
+
+    Returns a dict with per-wave and per-iteration byte/row counters;
+    obs.metrics carries it as the ``hist_traffic`` meta entry and
+    bench.py folds it into its JSON line."""
+    import math as _math
+
+    if pack_vpb is None:
+        # default: the packing factor tpu_bin_pack=auto would pick for
+        # this bin width (callers pass the ACTUAL vpb when they know it)
+        from .ops.bin_pack import pack_vpb as _pack_vpb
+        pack_vpb = _pack_vpb(max_bins)
+    bin_bytes = storage_features * _math.ceil(num_data / pack_vpb)
+    if waved:
+        sizes = _wave_schedule(num_leaves, wave_max, slots,
+                               1 if subtract else 2)
+        passes = len(sizes)  # root + per-wave boundaries (last skipped)
+    else:
+        sizes = [1] * (num_leaves - 1)
+        passes = num_leaves  # root + one masked full-data build per split
+    per_pass = bin_bytes + num_data * (gh_read_bytes + row_leaf_bytes)
+    grad_pass_bytes = 0 if fused_grad else num_data * 24
+    return {
+        "passes": passes,
+        "wave_sizes": sizes,
+        "rows_scanned_per_iter": passes * num_data,
+        "wave_rows_scanned": [num_data] * passes,
+        "bytes_per_pass": per_pass,
+        "bin_bytes_per_pass": bin_bytes,
+        "grad_pass_bytes": grad_pass_bytes,
+        "hist_bytes_per_iter": passes * per_pass + grad_pass_bytes,
+        "pack_vpb": pack_vpb,
+        "gh_read_bytes": gh_read_bytes,
+        "subtract": subtract,
+        "fused_grad": fused_grad,
+    }
 
 
 def grow_tree_waved(bins_fm: jax.Array,
@@ -683,8 +753,31 @@ def grow_tree_waved(bins_fm: jax.Array,
                     mono_pairwise: bool = False,
                     shard_mesh=None,
                     sparse_shape=None,
-                    batched_partition=None):
+                    batched_partition=None,
+                    fused_grad=None,
+                    subtract_siblings: bool = True,
+                    hist_deterministic: bool = False):
     """Leaf-wise growth with waved (batched) histogram construction.
+
+    fused_grad: optional (pointwise_fn, label, weight_or_None, score)
+    from the objective (objectives.pointwise_grad_fn): grad/hess are
+    then DERIVED inside the grower — bitwise-identical formulas to
+    objective.get_gradients — instead of arriving as materialized [N]
+    buffers, and on the pallas path the multi-leaf kernel computes them
+    IN-KERNEL from (score, label[, weight], mask), so the standalone
+    gradient/bagging element-wise pass and the [N, 3] ghT round-trip
+    through HBM disappear (~0.5 GB/iter of the cost model). The
+    `grad`/`hess` arguments may be None in this mode.
+
+    subtract_siblings: True (default) builds each split's SMALLER child
+    and derives the larger by subtraction from the pooled parent
+    (ref: serial_tree_learner.cpp:582); the wave schedule packs one
+    slot per split. False is the no-subtraction ORACLE: both children
+    are built directly (two slots per split, more waves) — retained for
+    A/B parity checks and the traffic counters' baseline.
+
+    hist_deterministic: Kahan-compensated fixed-chunk accumulation in
+    the XLA histogram paths (`deterministic_hist` knob).
 
     batched_partition: apply each wave's splits in one gathered pass
     (partition.apply_wave_splits) instead of per-split passes. None =
@@ -710,16 +803,18 @@ def grow_tree_waved(bins_fm: jax.Array,
     `grow_tree`).
 
     quant: optional (g_int [N] int-valued f32, h_int [N] int-valued f32,
-    g_scale, h_scale) from the gradient discretizer. On the pallas path
-    the histogram passes then run the int8 x int8 -> int32 MXU kernel
-    (exact integer accumulation at twice the bf16 rate — the TPU shape of
-    the reference's quantized histograms, gradient_discretizer.hpp:23)
-    and the int32 results are scaled back to the f32 statistics. The
+    g_scale, h_scale) from the gradient discretizer. The histogram
+    passes then run the int8 x int8 -> int32 kernel — the MXU pallas
+    kernel on device backends (exact integer accumulation at twice the
+    bf16 rate, the TPU shape of the reference's quantized histograms,
+    gradient_discretizer.hpp:23), its exact-integer XLA twin elsewhere
+    — and the int32 results are scaled back to the f32 statistics. The
     `grad`/`hess` arguments must already be the dequantized values
     (g_int * g_scale) so all non-histogram math is unchanged.
     """
     assert forced is None, "waved growth does not support forced splits"
-    from .ops.pallas_histogram import hist_multi, hist_pallas_multi_int8
+    from .ops.pallas_histogram import (hist_multi, hist_multi_int8,
+                                       hist_pallas_multi_fused)
 
     if sparse_shape is not None:
         assert bundle is None and quant is None, \
@@ -736,6 +831,21 @@ def grow_tree_waved(bins_fm: jax.Array,
 
     use_shard_hist = (shard_mesh is not None and shard_mesh.size > 1
                       and hist_impl == "pallas")
+    use_kernel_fused = False
+    if fused_grad is not None:
+        assert quant is None and sparse_shape is None, \
+            "fused gradients compose with neither int8 hist nor COO"
+        fg_fn, fg_label, fg_weight, fg_score = fused_grad
+        # derive grad/hess from the pointwise objective — bitwise the
+        # same values get_gradients would have produced, but XLA can now
+        # fuse the element-wise math straight into its consumers instead
+        # of round-tripping materialized [N] buffers through HBM
+        grad, hess = fg_fn(fg_score, fg_label, fg_weight)
+        # build_bins <= 256 keeps bin ids byte-representable — the fused
+        # kernel reads bins through the byte-sectioned layout, so uint16
+        # storage (max_bin > 256) must stay on the materialized-ghT path
+        use_kernel_fused = (hist_impl == "pallas" and bundle is None
+                            and shard_mesh is None and build_bins <= 256)
     if sparse_shape is not None:
         def multi_raw(bins, ghT_, row_leaf, ids):
             # O(nnz) segment-sum wave pass (the sparse row-wise
@@ -743,7 +853,7 @@ def grow_tree_waved(bins_fm: jax.Array,
             return hist_ops.hist_multi_sparse(
                 bins, ghT_, row_leaf, ids, num_features=num_features,
                 max_bins=max_bins, num_slots=ids.shape[0])
-    elif quant is not None and hist_impl == "pallas":
+    elif quant is not None:
         g_int, h_int, g_scale, h_scale = quant
         m8 = sample_mask.astype(jnp.int8)
         ghT_i8 = jnp.stack([g_int.astype(jnp.int8) * m8,
@@ -763,11 +873,24 @@ def grow_tree_waved(bins_fm: jax.Array,
                 return _multi_i32(bins, ghT_i8, row_leaf,
                                   ids).astype(f32) * hscale_vec
         else:
+            # default-capable on every backend: the pallas MXU kernel
+            # where Mosaic runs, the exact-integer XLA contraction
+            # elsewhere — identical int32 histograms either way
             def multi_raw(bins, ghT_unused, row_leaf, ids):
-                hist_i = hist_pallas_multi_int8(bins, ghT_i8, row_leaf, ids,
-                                                max_bins=build_bins,
-                                                num_slots=ids.shape[0])
+                hist_i = hist_multi_int8(bins, ghT_i8, row_leaf, ids,
+                                         max_bins=build_bins,
+                                         num_slots=ids.shape[0],
+                                         impl=hist_impl)
                 return hist_i.astype(f32) * hscale_vec
+    elif use_kernel_fused:
+        def multi_raw(bins, ghT_unused, row_leaf, ids):
+            # gradient pass fused INTO the histogram kernel: reads
+            # (score, label[, weight], mask) and computes gh in VMEM —
+            # ghT never exists in HBM (see hist_pallas_multi_fused)
+            return hist_pallas_multi_fused(
+                bins, fg_score, fg_label, fg_weight, sample_mask,
+                row_leaf, ids, grad_fn=fg_fn, max_bins=build_bins,
+                num_slots=ids.shape[0], precise=hist_precision)
     elif use_shard_hist:
         multi_raw = _sharded_pallas_multi(
             shard_mesh, max_bins=build_bins, precision=hist_precision,
@@ -780,7 +903,8 @@ def grow_tree_waved(bins_fm: jax.Array,
             # for 42
             return hist_multi(bins, ghT_, row_leaf, ids,
                               max_bins=build_bins, num_slots=ids.shape[0],
-                              impl=hist_impl, precision=hist_precision)
+                              impl=hist_impl, precision=hist_precision,
+                              deterministic=hist_deterministic)
     if bundle is None:
         multi = multi_raw
     else:
@@ -792,8 +916,11 @@ def grow_tree_waved(bins_fm: jax.Array,
             totals = jnp.sum(hg[:, 0], axis=1)  # [S, 3]
             return expand_bundle_hist(hg, group_of, offset_of, nb_arr,
                                       max_bins, totals)
-    ghT = jnp.stack([grad * sample_mask, hess * sample_mask, sample_mask],
-                    axis=1).astype(jnp.float32)
+    # the gradient/bagging element-wise product: skipped entirely when
+    # the kernel computes gh in-place (fused_grad on the pallas path)
+    ghT = None if use_kernel_fused else jnp.stack(
+        [grad * sample_mask, hess * sample_mask, sample_mask],
+        axis=1).astype(jnp.float32)
 
     if interaction_groups is not None:
         interaction_groups = jnp.asarray(interaction_groups, bool)
@@ -980,7 +1107,8 @@ def grow_tree_waved(bins_fm: jax.Array,
                if mono_pairwise else None)
     wbox_hi = (jnp.full((L, num_features), max_bins - 1, jnp.int32)
                if mono_pairwise else None)
-    schedule = _wave_schedule(L, wave_max, SLOTS)
+    schedule = _wave_schedule(L, wave_max, SLOTS,
+                              1 if subtract_siblings else 2)
     for wi, W in enumerate(schedule):
         (row_leaf, leaves, used_features, n_applied, wbox_lo, wbox_hi), \
             ys = lax.scan(
@@ -1021,14 +1149,27 @@ def grow_tree_waved(bins_fm: jax.Array,
         # (a split leaf's candidate becomes `unknown` within the wave),
         # and invalid steps write to the out-of-bounds row L, which jit
         # scatters drop — so the batch has no index collisions.
-        small_ids = jnp.where(ys["valid"], ys["small_id"], -2)
-        smalls = multi(bins_fm, ghT, row_leaf, small_ids)  # [W, F, B, 3]
-        parents = pool[ys["left_id"]]                      # [W, F, B, 3]
-        small_h = smalls.astype(f32)
-        large_h = hist_ops.subtract_histogram(parents, small_h)
-        ls = ys["left_smaller"][:, None, None, None]
-        left_h = jnp.where(ls, small_h, large_h)
-        right_h = jnp.where(ls, large_h, small_h)
+        if subtract_siblings:
+            small_ids = jnp.where(ys["valid"], ys["small_id"], -2)
+            smalls = multi(bins_fm, ghT, row_leaf, small_ids)  # [W, F, B, 3]
+            parents = pool[ys["left_id"]]                      # [W, F, B, 3]
+            small_h = smalls.astype(f32)
+            large_h = hist_ops.subtract_histogram(parents, small_h)
+            ls = ys["left_smaller"][:, None, None, None]
+            left_h = jnp.where(ls, small_h, large_h)
+            right_h = jnp.where(ls, large_h, small_h)
+        else:
+            # no-subtraction ORACLE (tpu_wave_subtract=False): build BOTH
+            # children directly. Two slots per split — the schedule above
+            # already halved the wave width — and the pass accumulates
+            # the rows of the full frontier instead of only the smaller
+            # siblings. Kept as the parity/traffic baseline.
+            lids = jnp.where(ys["valid"], ys["left_id"], -2)
+            rids = jnp.where(ys["valid"], ys["right_id"], -2)
+            both = multi(bins_fm, ghT, row_leaf,
+                         jnp.concatenate([lids, rids]))
+            left_h = both[:W].astype(f32)
+            right_h = both[W:].astype(f32)
         left_w = jnp.where(ys["valid"], ys["left_id"], L)
         right_w = jnp.where(ys["valid"], ys["right_id"], L)
         pool = pool.at[left_w].set(left_h)

@@ -14,6 +14,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .bin_pack import PackedBins, unpack_feature, unpack_rows
 from .split import MISSING_NAN
 from ..obs.metrics import global_metrics
 
@@ -57,10 +58,12 @@ def feature_bins(bins_fm, feature: jax.Array, bundle=None,
     """Logical [N] bin column of `feature` — a plain row slice for a
     dense matrix, an on-the-fly decode of the EFB-bundled matrix
     (bundle = (group_of, offset_of, num_bins) device arrays; ref:
-    feature_group.h bin_offsets_ decoding), or a COO materialization
-    for SparseBins storage."""
+    feature_group.h bin_offsets_ decoding), a shift/mask unpack for
+    PackedBins, or a COO materialization for SparseBins storage."""
     if isinstance(bins_fm, SparseBins):
         return sparse_feature_bins(bins_fm, feature, num_data)
+    if isinstance(bins_fm, PackedBins):
+        return unpack_feature(bins_fm, feature)
     if bundle is None:
         return jnp.take(bins_fm, feature, axis=0).astype(jnp.int32)
     group_of, offset_of, nb = bundle
@@ -84,6 +87,8 @@ def _per_row_feature_bins(bins_fm: jax.Array, feat: jax.Array,
     feature_bins for per-row feature indices (feat: [N] int32)."""
     n = feat.shape[0]
     rows = jnp.arange(n)
+    if isinstance(bins_fm, PackedBins):
+        return unpack_rows(bins_fm, feat, rows)
     if bundle is None:
         return bins_fm[feat, rows].astype(jnp.int32)
     group_of, offset_of, nb = bundle

@@ -248,7 +248,9 @@ class VotingParallelGBDT(_DataParallelMixin, GBDT):
             static = dict(self._static)
             grow = make_sharded_voting_grow(
                 self.mesh, top_k=top_k,
-                hist_impl=hist_ops.resolve_impl(config.tpu_hist_impl),
+                hist_impl=("xla" if config.deterministic_hist else
+                           hist_ops.resolve_impl(config.tpu_hist_impl)),
+                hist_deterministic=bool(config.deterministic_hist),
                 has_categorical=self._has_categorical, **static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
@@ -294,7 +296,9 @@ class FeatureParallelGBDT(GBDT):
             static = dict(self._static)
             grow = make_sharded_feature_grow(
                 self.mesh,
-                hist_impl=hist_ops.resolve_impl(config.tpu_hist_impl),
+                hist_impl=("xla" if config.deterministic_hist else
+                           hist_ops.resolve_impl(config.tpu_hist_impl)),
+                hist_deterministic=bool(config.deterministic_hist),
                 has_categorical=self._has_categorical, **static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,

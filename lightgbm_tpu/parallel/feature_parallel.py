@@ -51,6 +51,7 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
                                axis_name: str = mesh_lib.DATA_AXIS,
                                hist_dtype=jnp.float32,
                                hist_impl: str = "xla",
+                               hist_deterministic: bool = False,
                                has_categorical: bool = True,
                                mono_pairwise: bool = False):
     """Runs INSIDE shard_map with fully-replicated inputs; each shard
@@ -76,7 +77,8 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
     fmask_loc = lax.dynamic_slice_in_dim(feature_mask, start, fp, axis=0)
 
     build = functools.partial(hist_ops.build_histogram, max_bins=max_bins,
-                              dtype=f32, row_chunk=0, impl=hist_impl)
+                              dtype=f32, row_chunk=0, impl=hist_impl,
+                              deterministic=hist_deterministic)
     sync = functools.partial(_sync_best_split, feat_offset=start,
                              axis_name=axis_name)
 
@@ -246,14 +248,16 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
 def make_sharded_feature_grow(mesh, *, num_leaves: int, max_bins: int,
                               hist_impl: str = "xla",
                               has_categorical: bool = True,
-                              mono_pairwise: bool = False):
+                              mono_pairwise: bool = False,
+                              hist_deterministic: bool = False):
     """jit(shard_map(grow_tree_feature_parallel)): everything replicated
     in and out; sharding is purely over the computation."""
     grow = functools.partial(grow_tree_feature_parallel,
                              num_leaves=num_leaves, max_bins=max_bins,
                              num_shards=mesh.size, hist_impl=hist_impl,
                              has_categorical=has_categorical,
-                             mono_pairwise=mono_pairwise)
+                             mono_pairwise=mono_pairwise,
+                             hist_deterministic=hist_deterministic)
     rep = P()
     meta_spec = FeatureMeta(*([rep] * len(FeatureMeta._fields)))
     hp_spec = SplitHyperParams(*([rep] * len(SplitHyperParams._fields)))

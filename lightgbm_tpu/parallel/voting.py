@@ -100,7 +100,8 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
                      axis_name: str = mesh_lib.DATA_AXIS,
                      hist_dtype=jnp.float32, hist_impl: str = "xla",
                      has_categorical: bool = True,
-                     mono_pairwise: bool = False):
+                     mono_pairwise: bool = False,
+                     hist_deterministic: bool = False):
     """Grow one tree with voting-parallel split search. Runs INSIDE
     shard_map: all row-indexed inputs are this shard's slice; returned
     TreeArrays are replicated, row_leaf is the local slice.
@@ -119,7 +120,8 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
     k_eff = min(top_k, num_features)
 
     build = functools.partial(hist_ops.build_histogram, max_bins=max_bins,
-                              dtype=f32, row_chunk=0, impl=hist_impl)
+                              dtype=f32, row_chunk=0, impl=hist_impl,
+                              deterministic=hist_deterministic)
     vote = functools.partial(_vote_and_reduce, meta=meta, hp=hp,
                              feature_mask=feature_mask, num_candidates=C,
                              top_k=k_eff, axis_name=axis_name,
@@ -296,14 +298,16 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
 def make_sharded_voting_grow(mesh, *, num_leaves: int, max_bins: int,
                              top_k: int, hist_impl: str = "xla",
                              has_categorical: bool = True,
-                             mono_pairwise: bool = False):
+                             mono_pairwise: bool = False,
+                             hist_deterministic: bool = False):
     """jit(shard_map(grow_tree_voting)): rows sharded over "data",
     everything else replicated; tree replicated out, row_leaf sharded."""
     grow = functools.partial(grow_tree_voting, num_leaves=num_leaves,
                              max_bins=max_bins, top_k=top_k,
                              hist_impl=hist_impl,
                              has_categorical=has_categorical,
-                             mono_pairwise=mono_pairwise)
+                             mono_pairwise=mono_pairwise,
+                             hist_deterministic=hist_deterministic)
     data = P(None, mesh_lib.DATA_AXIS)   # bins [F, N]
     rows = P(mesh_lib.DATA_AXIS)         # [N]
     rep = P()

@@ -287,3 +287,58 @@ def test_mono_pairwise_parallel_learners_match_serial():
         _w.simplefilter("error")
         lgb.Booster({**params, "tree_learner": "voting", "top_k": 8,
                      "verbosity": -1}, lgb.Dataset(X, label=y))
+
+
+def test_int8_mesh_psum_exact_parity():
+    """The promoted-to-default int8 histogram path on the mesh: the
+    per-shard int8 kernel + INT32 psum must reproduce the single-device
+    int8 kernel EXACTLY — integer accumulation commutes across shards,
+    unlike f32 (the point of reducing quantized histograms, ref:
+    data_parallel_tree_learner.cpp:290-297)."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.learner import _sharded_pallas_multi
+    from lightgbm_tpu.ops.pallas_histogram import (hist_multi_int8_xla,
+                                                   hist_pallas_multi_int8)
+    from lightgbm_tpu.parallel import mesh as mesh_lib
+
+    r = np.random.RandomState(4)
+    n, f, b, slots = 1003, 5, 15, 8  # n not a mesh multiple: pads rows
+    bins = jnp.asarray(r.randint(0, b, (f, n)), jnp.uint8)
+    mask = (r.rand(n) < 0.8).astype(np.int8)
+    ghT_i8 = jnp.asarray(np.stack([(r.randint(-3, 4, n) * mask),
+                                   (r.randint(0, 5, n) * mask), mask],
+                                  axis=1), jnp.int8)
+    row_leaf = jnp.asarray(r.randint(0, slots, n), jnp.int32)
+    ids = jnp.asarray(np.arange(slots, dtype=np.int32))
+
+    mesh = mesh_lib.get_mesh(8)
+    sharded = _sharded_pallas_multi(mesh, max_bins=b, precision="highest",
+                                    int8=True)
+    out_mesh = sharded(bins, ghT_i8, row_leaf, ids)
+    out_single = hist_pallas_multi_int8(bins, ghT_i8, row_leaf, ids,
+                                        max_bins=b, num_slots=slots,
+                                        interpret=True)
+    out_xla = hist_multi_int8_xla(bins, ghT_i8, row_leaf, ids,
+                                  max_bins=b, num_slots=slots)
+    assert out_mesh.dtype == jnp.int32
+    np.testing.assert_array_equal(np.asarray(out_mesh),
+                                  np.asarray(out_single))
+    np.testing.assert_array_equal(np.asarray(out_mesh),
+                                  np.asarray(out_xla))
+
+
+def test_deterministic_hist_under_sharding():
+    """deterministic_hist (Kahan fixed-chunk accumulation) must make
+    data-parallel training track serial training TIGHTER than the
+    plain-f32 1e-3 gate above — the reorders-safely-under-sharding
+    property at the training level."""
+    X, y = make_regression(1024)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1, "seed": 7,
+              "deterministic_hist": True}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=10)
+    parallel = lgb.train({**params, "tree_learner": "data"},
+                         lgb.Dataset(X, label=y), num_boost_round=10)
+    np.testing.assert_allclose(parallel.predict(X), serial.predict(X),
+                               rtol=1e-4, atol=1e-4)

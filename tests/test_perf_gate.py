@@ -1,0 +1,86 @@
+"""tools/check_perf_gate.py — the CI perf-regression gate over the
+BENCH_*.json trajectory and the histogram traffic-model floor
+(ISSUE 7 satellite; ROADMAP item 4's driver-visible-proof debt)."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+import check_perf_gate  # noqa: E402
+
+
+def test_gate_passes_on_repo_state(capsys):
+    assert check_perf_gate.main([]) == 0
+    out = capsys.readouterr().out
+    assert "perf gate OK" in out
+    assert "13-pass schedule" in out
+
+
+def test_gate_reduction_floor_is_acceptance_number():
+    with open(check_perf_gate.FLOOR_PATH) as fh:
+        floor = json.load(fh)
+    assert floor["hist"]["min_bytes_reduction"] >= 1.8
+
+
+def test_gate_fails_on_traffic_regression(tmp_path, capsys):
+    """A candidate whose own hist_bytes_reduction fell below the floor
+    (scheduler/encoding regression) must fail the gate — the ratio is
+    N-invariant, so it works for shrunken relay-fallback runs too."""
+    fat = {"metric": "boosting_iters_per_sec_higgs_shape",
+           "value": 1.0, "vs_baseline": 1.0,
+           "unit": "iters/sec (platform=cpu)",
+           "hist_bytes_per_iter": int(12e9),
+           "hist_bytes_reduction": 1.0}
+    cand = tmp_path / "BENCH_candidate.json"
+    cand.write_text(json.dumps(fat))
+    assert check_perf_gate.main([str(cand)]) == 1
+    assert "hist_bytes_reduction" in capsys.readouterr().out
+
+
+def test_gate_accepts_unpacked_train_config_candidate(tmp_path):
+    """The standard 63-bin train bench (no packing, ~1.35x reduction,
+    bytes far above the packed fixture floor) must PASS: absolute bytes
+    are not comparable across configs/row counts, only the ratio is."""
+    ok = {"metric": "boosting_iters_per_sec_higgs_shape",
+          "value": 50.0, "vs_baseline": 13.0,
+          "unit": "iters/sec (N=10500000)",
+          "hist_bytes_per_iter": int(6.0e9),
+          "hist_bytes_reduction": 1.35}
+    cand = tmp_path / "BENCH_candidate.json"
+    cand.write_text(json.dumps(ok))
+    assert check_perf_gate.main([str(cand)]) == 0
+
+
+def test_gate_fails_on_throughput_drop(tmp_path, capsys):
+    """A candidate >10% below the recorded same-platform floor fails."""
+    lines = check_perf_gate._load_bench_lines()
+    if not lines:
+        pytest.skip("no recorded BENCH trajectory")
+    cpu = [r for _, r in lines
+           if check_perf_gate._platform_of(r.get("unit", "")) == "cpu"]
+    if not cpu:
+        pytest.skip("no cpu BENCH lines recorded")
+    floor_v = max(r.get("vs_baseline", 0.0) for r in cpu)
+    slow = {"metric": "boosting_iters_per_sec_higgs_shape",
+            "value": 0.01, "vs_baseline": floor_v * 0.5,
+            "unit": "iters/sec (platform=cpu)"}
+    cand = tmp_path / "BENCH_candidate.json"
+    cand.write_text(json.dumps(slow))
+    assert check_perf_gate.main([str(cand)]) == 1
+    assert "dropped" in capsys.readouterr().out
+
+
+def test_gate_parses_driver_wrapper_shape():
+    """The driver stores bench output as {"n","cmd","rc","tail"}; the
+    gate must dig the contract line out of `tail`."""
+    rec = check_perf_gate._extract_metric_record({
+        "n": 9, "rc": 0,
+        "tail": 'noise\n{"metric": "boosting_iters_per_sec_higgs_shape", '
+                '"value": 1.5, "vs_baseline": 0.39, "unit": "iters/sec"}\n'})
+    assert rec is not None and rec["vs_baseline"] == 0.39
+    assert check_perf_gate._extract_metric_record({"tail": "junk"}) is None
