@@ -262,9 +262,12 @@ def _measure():
     telemetry = _telemetry_enabled()
     if telemetry:
         # record spans for the phase-time summary folded into the JSON
-        # line below (export/exit-print still follow the env knobs)
+        # line below (export/exit-print still follow the env knobs),
+        # and arm the span-boundary HBM watermark sampler (no-op on CPU)
         from lightgbm_tpu.obs import global_tracer
+        from lightgbm_tpu.obs.memory import global_watermarks
         global_tracer.enable()
+        global_watermarks.enable()
 
     import jax
     # persistent compilation cache: a retried/repeated bench attempt (or
@@ -355,6 +358,18 @@ def _measure():
             "hist_traffic_oracle"]["hist_bytes_per_iter"]
         result["hist_bytes_reduction"] = global_metrics.meta[
             "hist_bytes_reduction"]
+    # peak-HBM accounting (obs/memory.py): the analytic model is
+    # always-on meta; the measured peak exists only on accelerator
+    # backends (memory_stats() is None on CPU). check_perf_gate.py
+    # holds model-vs-measured to the recorded band when both appear.
+    mm = global_metrics.meta.get("mem_model")
+    if mm:
+        result["mem_peak_model_bytes"] = mm["peak_bytes"]
+        result["mem_peak_phase"] = mm["peak_phase"]
+    from lightgbm_tpu.obs.memory import measured_peak_bytes
+    measured = measured_peak_bytes()
+    if measured:
+        result["mem_peak_measured_bytes"] = measured
     if telemetry:
         # fold the phase-time summary into the one JSON line instead of
         # leaving it buried in raw stderr
@@ -365,6 +380,13 @@ def _measure():
         for name, agg in global_tracer.summary().items():
             phases[name] = round(agg["seconds"], 4)
         result["phases"] = phases
+        # live per-phase HBM watermarks (accelerator backends only —
+        # the sampler self-disables where memory_stats() is None)
+        from lightgbm_tpu.obs.memory import global_watermarks
+        wm = global_watermarks.summary()
+        if wm:
+            result["mem_phase_watermarks"] = {
+                name: ph["delta_bytes"] for name, ph in wm.items()}
     out_path = os.environ.get("BENCH_OUT")
     if out_path:  # orchestrated: parent prints the single contract line
         with open(out_path, "w") as fh:

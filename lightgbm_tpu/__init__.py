@@ -13,6 +13,7 @@ from .callback import (EarlyStopException, early_stopping,  # noqa: F401
                        log_evaluation, log_telemetry, record_evaluation,
                        record_telemetry, reset_parameter)
 from . import obs  # noqa: F401
+from .obs.memory import preflight  # noqa: F401  (HBM capacity planner)
 from . import serve  # noqa: F401
 from .engine import CVBooster, cv, train  # noqa: F401
 from .log import register_logger  # noqa: F401
@@ -31,7 +32,7 @@ __all__ = [
     "Dataset", "Booster", "LightGBMError",
     "train", "cv", "CVBooster",
     "early_stopping", "log_evaluation", "record_evaluation",
-    "log_telemetry", "record_telemetry", "obs", "serve",
+    "log_telemetry", "record_telemetry", "obs", "serve", "preflight",
     "reset_parameter", "EarlyStopException", "register_logger",
     "plot_importance", "plot_metric", "plot_split_value_histogram",
     "plot_tree", "create_tree_digraph", "plotting", "DatasetBuilder",

@@ -217,12 +217,14 @@ class GBDT:
         self._sample_mask = jnp.ones(self.num_data, jnp.float32)
         self._grad_scale = None  # GOSS amplification, set per iter
 
+        # valid-set state precedes _build_grow: the memory model it
+        # publishes accounts registered valid sets
+        self._valid_sets: List = []
+        self._valid_scores: List[np.ndarray] = []
         # grown-tree jit (shared across iterations; one XLA program per tree)
         self._build_grow(hist_ops.resolve_impl(config.tpu_hist_impl))
         self._update_score = jax.jit(
             lambda score, leaf_vals, row_leaf: score + leaf_vals[row_leaf])
-        self._valid_sets: List = []
-        self._valid_scores: List[np.ndarray] = []
 
     def _maybe_pack_bins(self, binned):
         """Bit-packed device bins for `binned`, or None when ineligible
@@ -329,6 +331,7 @@ class GBDT:
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
         self._note_hist_traffic()
+        self._note_memory_model()
 
     def _resolve_fused_grad(self):
         """The objective's pointwise gradient fn when the fused
@@ -347,6 +350,24 @@ class GBDT:
             return None
         return self.objective.pointwise_grad_fn()
 
+    def _resolved_hist_shape(self) -> Dict:
+        """The booster's ACTUAL resolved histogram-pass shape/knobs —
+        the single source both driver-visible cost models (the traffic
+        model and the peak-memory model) consume, so they can never
+        desynchronize on e.g. the quantization gate."""
+        waved = self._use_waved()
+        return dict(
+            num_data=int(self.num_data),
+            storage_features=int(self.train_set.bins_fm.shape[0]),
+            max_bins=int(self._num_bundle_bins
+                         or self._static["max_bins"]),
+            num_leaves=self._static["num_leaves"],
+            wave_max=max(self._resolved_wave_max(), 1),
+            waved=waved,
+            quant_int8=(self._quant_enabled and waved and
+                        int(self.config.num_grad_quant_bins) <= 126),
+        )
+
     def _note_hist_traffic(self) -> None:
         """Publish the static per-iteration histogram traffic model (and
         its unpacked / no-subtraction / unfused oracle) through
@@ -355,18 +376,8 @@ class GBDT:
         if self._sparse_shape is not None:
             return
         from .learner import hist_traffic_model
-        waved = self._use_waved()
-        quant_int8 = (self._quant_enabled and waved and
-                      int(self.config.num_grad_quant_bins) <= 126)
-        kw = dict(
-            num_data=int(self.num_data),
-            storage_features=int(self.train_set.bins_fm.shape[0]),
-            max_bins=int(self._num_bundle_bins
-                         or self._static["max_bins"]),
-            num_leaves=self._static["num_leaves"],
-            wave_max=max(self._resolved_wave_max(), 1),
-            waved=waved,
-        )
+        kw = self._resolved_hist_shape()
+        quant_int8 = kw.pop("quant_int8")
         actual = hist_traffic_model(
             **kw, pack_vpb=self._bin_pack_vpb,
             gh_read_bytes=3 if quant_int8 else 12,
@@ -384,6 +395,58 @@ class GBDT:
             "hist_bytes_reduction",
             round(oracle["hist_bytes_per_iter"]
                   / max(actual["hist_bytes_per_iter"], 1), 4))
+
+    def _memory_model_kwargs(self) -> Dict:
+        """The analytic peak-HBM model's kwargs with every knob RESOLVED
+        the way this booster actually resolved it (pack factor, fused /
+        quantized state, wave mode, mesh size) — obs/memory.py's
+        ``preflight`` derives the same from a raw config for the
+        before-any-allocation path; this is the ground truth after."""
+        cfg = self.config
+        shape = self._resolved_hist_shape()
+        fused = self._fused_grad_fn is not None
+        mesh = getattr(self, "_shard_mesh", None)
+        return dict(
+            num_data=shape["num_data"],
+            num_features=shape["storage_features"],
+            max_bins=shape["max_bins"],
+            num_leaves=shape["num_leaves"],
+            num_class=self.num_tree_per_iteration,
+            num_iterations=int(cfg.num_iterations),
+            pack_vpb=int(self._bin_pack_vpb),
+            quantized=shape["quant_int8"],
+            fused_grad=fused,
+            kernel_fused=fused and self._hist_impl == "pallas",
+            waved=shape["waved"],
+            wave_max=shape["wave_max"],
+            num_shards=int(mesh.size) if mesh is not None else 1,
+            has_weight=self.train_set.metadata.weight is not None,
+            valid_rows=[vs.num_data for vs, _ in self._valid_sets],
+        )
+
+    def _note_memory_model(self) -> None:
+        """Publish the analytic peak-HBM model through obs.metrics
+        (always-on meta -> bench.py JSON -> tools/check_perf_gate.py
+        ceiling) and run the capacity preflight: predicted peak vs
+        device capacity, warning (tpu_preflight=warn, the default) or
+        raising (=error) with concrete knob recommendations instead of
+        OOMing mid-run. Capacity is unknown on CPU (no memory_stats),
+        so the check is silent there unless LGBM_TPU_HBM_BYTES is set."""
+        if self._sparse_shape is not None:
+            return  # COO working sets are nnz-shaped, not modeled yet
+        from .obs import memory as obs_memory
+        kw = self._memory_model_kwargs()
+        report = obs_memory.train_report(kw)
+        global_metrics.set_meta("mem_model", report.model)
+        global_metrics.set_meta("mem_peak_model_bytes", report.peak_bytes)
+        mode = str(self.config.tpu_preflight).lower()
+        if mode in ("off", "0", "false", "none") or report.fits is not False:
+            return
+        if mode == "error":
+            raise obs_memory.PreflightError(
+                "memory preflight: " + report.render())
+        from . import log
+        log.warning("memory preflight: " + report.render())
 
     def _resolved_wave_max(self) -> int:
         """tpu_wave_max with -1 (auto) resolved: exact order for softmax
@@ -1074,6 +1137,10 @@ class GBDT:
         self._valid_bins.append(vbins if vbins is not None
                                 else valid_set.device_bins())
         self._fused = None  # fused program must include the new valid set
+        # the valid bins + scores just moved on device: refresh the
+        # published peak-memory model (and re-judge the preflight) so a
+        # big eval set can't silently blow past a "fits" verdict
+        self._note_memory_model()
 
     def _valid_raw(self, i: int) -> np.ndarray:
         """Valid set i's raw features as a DENSE array — the host tree

@@ -180,6 +180,7 @@ _ALIASES: Dict[str, List[str]] = {
     "deterministic_hist": ["tpu_deterministic_hist"],
     "tpu_dart_fused_max_bytes": [],
     "tpu_predict_chunk": ["predict_chunk", "predict_chunk_rows"],
+    "tpu_preflight": ["preflight", "memory_preflight"],
     # serving knobs (serve/ subsystem)
     "serve_max_batch_rows": ["serve_max_batch"],
     "serve_max_wait_ms": ["serve_max_wait"],
@@ -539,6 +540,14 @@ class Config:
     # tail pads up to a power-of-two bucket — so any N reuses a small
     # fixed set of compiled traversal programs.
     tpu_predict_chunk: int = 1 << 20
+    # HBM capacity preflight (obs/memory.py): the analytic peak-memory
+    # model is compared against device capacity at booster construction;
+    # "warn" logs the verdict plus concrete knob recommendations when it
+    # doesn't fit, "error" raises PreflightError (fail fast instead of
+    # OOMing mid-run), "off" publishes the model through obs meta but
+    # never judges. No effect on backends that report no memory stats
+    # (CPU) unless LGBM_TPU_HBM_BYTES overrides the capacity.
+    tpu_preflight: str = "warn"
     # serving (serve/ async model server; task=serve and the in-process
     # API). Micro-batching: requests coalesce until serve_max_batch_rows
     # rows are pending or the OLDEST pending request has waited

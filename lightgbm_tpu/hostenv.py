@@ -12,7 +12,32 @@ interpreter startup.
 from __future__ import annotations
 
 import os
+import socket
+import sys
 from typing import Dict, Optional
+
+
+def host_labels() -> Dict[str, str]:
+    """Host/process identity labels for trace metadata (obs/trace.py
+    emits them as Chrome ``process_labels`` so multi-process Perfetto
+    traces are tellable apart).
+
+    Deliberately does NOT probe a jax backend: reading
+    ``jax.distributed.global_state`` is passive, while touching devices
+    can hang on the downed relay (module docstring). Process index /
+    count appear only when jax.distributed is initialized."""
+    labels = {"hostname": socket.gethostname(), "pid": str(os.getpid())}
+    jax_mod = sys.modules.get("jax")
+    if jax_mod is not None:
+        try:
+            state = jax_mod.distributed.global_state
+            if getattr(state, "process_id", None) is not None:
+                labels["process_index"] = str(state.process_id)
+            if getattr(state, "num_processes", None):
+                labels["num_processes"] = str(state.num_processes)
+        except Exception:
+            pass
+    return labels
 
 
 def cpu_child_env(n_devices: Optional[int] = None,

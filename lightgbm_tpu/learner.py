@@ -633,6 +633,12 @@ def grow_tree(bins_fm: jax.Array,
     return tree_arrays, state.row_leaf
 
 
+# multi-leaf histogram kernel slot count: 128 MXU lanes // 3 channels.
+# Shared by the wave scheduler, the traffic model, and the peak-memory
+# model (obs/memory.py) — the wave slab is [HIST_SLOTS, F, B, 3].
+HIST_SLOTS = 42
+
+
 def _wave_schedule(num_leaves: int, wave_max: int, slots: int,
                    slots_per_split: int = 1):
     """Static split-batch sizes: 1, 2, 4, ... doubling, capped at
@@ -672,7 +678,7 @@ def _wave_schedule(num_leaves: int, wave_max: int, slots: int,
 
 def hist_traffic_model(*, num_data: int, storage_features: int,
                        max_bins: int, num_leaves: int, wave_max: int,
-                       slots: int = 42, pack_vpb=None,
+                       slots: int = HIST_SLOTS, pack_vpb=None,
                        gh_read_bytes: int = 12, row_leaf_bytes: int = 4,
                        subtract: bool = True, fused_grad: bool = False,
                        waved: bool = True):
@@ -826,7 +832,7 @@ def grow_tree_waved(bins_fm: jax.Array,
                         else bundle[0].shape[0])
     L = num_leaves
     f32 = hist_dtype
-    SLOTS = 42  # 128 MXU columns // 3 channels
+    SLOTS = HIST_SLOTS  # 128 MXU columns // 3 channels
     build_bins = max_bins if bundle is None else num_bundle_bins
 
     use_shard_hist = (shard_mesh is not None and shard_mesh.size > 1

@@ -11,8 +11,13 @@ common.h:980,1044; global_timer dump at src/boosting/gbdt.cpp:29):
 - ``obs.metrics`` — per-iteration metrics registry: phase times,
   grad/hess norms, leaves grown, split-gain stats, JIT recompilation
   counts, device memory, collective traffic.
+- ``obs.memory`` — HBM memory observability: the analytic peak-memory
+  model (``train_memory_model`` / ``predict_memory_model``), live
+  per-phase watermarks sampled at span boundaries
+  (``global_watermarks``), and the ``preflight`` capacity planner that
+  fails fast (with knob recommendations) instead of OOMing mid-run.
 
-Both are disabled by default and their hot-path guards are single
+All are disabled by default and their hot-path guards are single
 attribute checks — training with telemetry off records nothing and
 allocates nothing per span/observation.
 """
@@ -20,6 +25,14 @@ allocates nothing per span/observation.
 from .trace import Tracer, global_tracer  # noqa: F401
 from .metrics import (LatencyReservoir, MetricsRegistry,  # noqa: F401
                       global_metrics)
+from .memory import (PhaseWatermarks, PreflightError,  # noqa: F401
+                     PreflightReport, device_capacity_bytes,
+                     global_watermarks, predict_memory_model, preflight,
+                     preflight_predict, train_memory_model)
 
 __all__ = ["Tracer", "global_tracer", "LatencyReservoir",
-           "MetricsRegistry", "global_metrics"]
+           "MetricsRegistry", "global_metrics",
+           "PhaseWatermarks", "PreflightError", "PreflightReport",
+           "device_capacity_bytes", "global_watermarks",
+           "train_memory_model", "predict_memory_model",
+           "preflight", "preflight_predict"]

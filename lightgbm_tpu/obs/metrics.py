@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import os
 import random
+import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -43,10 +44,17 @@ class LatencyReservoir:
     path both record through it instead of keeping local sample lists.
     The RNG is seeded per reservoir, so summaries are reproducible for
     a deterministic request sequence.
+
+    Thread-safe: ``note`` is a read-modify-write of count/totals/samples
+    and the serve/ path records from both the asyncio loop and its
+    single-thread executor, so every mutation (and the quantile read's
+    sample snapshot) holds the per-reservoir lock. The lock is
+    uncontended in the common case — ~100 ns per note, far below the
+    events being timed.
     """
 
     __slots__ = ("capacity", "count", "total_seconds", "max_seconds",
-                 "_samples", "_rng")
+                 "_samples", "_rng", "_lock")
 
     def __init__(self, capacity: int = 4096, seed: int = 0) -> None:
         self.capacity = max(int(capacity), 1)
@@ -55,25 +63,28 @@ class LatencyReservoir:
         self.max_seconds = 0.0
         self._samples: List[float] = []
         self._rng = random.Random(seed)
+        self._lock = threading.Lock()
 
     def note(self, seconds: float) -> None:
         s = float(seconds)
-        self.count += 1
-        self.total_seconds += s
-        if s > self.max_seconds:
-            self.max_seconds = s
-        if len(self._samples) < self.capacity:
-            self._samples.append(s)
-        else:
-            j = self._rng.randrange(self.count)
-            if j < self.capacity:
-                self._samples[j] = s
+        with self._lock:
+            self.count += 1
+            self.total_seconds += s
+            if s > self.max_seconds:
+                self.max_seconds = s
+            if len(self._samples) < self.capacity:
+                self._samples.append(s)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.capacity:
+                    self._samples[j] = s
 
     def quantiles(self, qs: Sequence[float]) -> Tuple[float, ...]:
         """Nearest-rank quantiles over the reservoir (0.0 when empty)."""
-        if not self._samples:
+        with self._lock:
+            ordered = sorted(self._samples)
+        if not ordered:
             return tuple(0.0 for _ in qs)
-        ordered = sorted(self._samples)
         last = len(ordered) - 1
         return tuple(ordered[min(int(q * len(ordered)), last)] for q in qs)
 
@@ -118,6 +129,10 @@ class MetricsRegistry:
         # keeping server-local sample lists
         self.latency_reservoirs: Dict[str, LatencyReservoir] = {}
         self.counters: Dict[str, int] = {}
+        # guards the always-on serving accumulators (counters, reservoir
+        # creation, predict totals): serve/ records from the asyncio
+        # loop AND its executor thread concurrently
+        self._mutex = threading.Lock()
 
     # ------------------------------------------------------------------
     def enable(self) -> None:
@@ -126,6 +141,10 @@ class MetricsRegistry:
         # the sink to fire (summary-only: no exit print, no export)
         from .trace import global_tracer
         global_tracer.enable()
+        # arm the span-boundary HBM watermark sampler (self-disables on
+        # backends without memory_stats — obs/memory.py)
+        from .memory import global_watermarks
+        global_watermarks.enable()
 
     def disable(self) -> None:
         self.enabled = False
@@ -158,10 +177,20 @@ class MetricsRegistry:
         if not self.enabled or cur is None:
             return
         cur["iteration_seconds"] = time.perf_counter() - self._iter_t0
-        mem = self.device_memory_stats()
-        if mem is not None:
-            cur["device_bytes_in_use"] = mem.get("bytes_in_use")
-            cur["device_peak_bytes_in_use"] = mem.get("peak_bytes_in_use")
+        mem = self.per_device_memory_stats()
+        if mem:
+            # multi-chip runs must not under-report: the record carries
+            # the SUM of live bytes (fleet footprint) and the MAX peak
+            # (the device that OOMs first), plus the per-device rows
+            cur["device_bytes_in_use"] = sum(
+                int(s.get("bytes_in_use", 0) or 0) for s in mem)
+            cur["device_peak_bytes_in_use"] = max(
+                int(s.get("peak_bytes_in_use", 0) or 0) for s in mem)
+            if len(mem) > 1:
+                cur["device_bytes_in_use_per_device"] = [
+                    int(s.get("bytes_in_use", 0) or 0) for s in mem]
+                cur["device_peak_bytes_per_device"] = [
+                    int(s.get("peak_bytes_in_use", 0) or 0) for s in mem]
         cur["collective_calls_total"] = self.collective_calls
         cur["collective_bytes_total"] = self.collective_bytes
         self._current = None
@@ -229,7 +258,10 @@ class MetricsRegistry:
         """The named latency reservoir, created on first use."""
         res = self.latency_reservoirs.get(name)
         if res is None:
-            res = self.latency_reservoirs[name] = LatencyReservoir()
+            with self._mutex:  # one reservoir per name under races
+                res = self.latency_reservoirs.get(name)
+                if res is None:
+                    res = self.latency_reservoirs[name] = LatencyReservoir()
         return res
 
     def note_latency(self, name: str, seconds: float) -> None:
@@ -245,7 +277,8 @@ class MetricsRegistry:
         return self.latency(name).summary()
 
     def inc_counter(self, name: str, n: int = 1) -> None:
-        self.counters[name] = self.counters.get(name, 0) + int(n)
+        with self._mutex:
+            self.counters[name] = self.counters.get(name, 0) + int(n)
 
     def counter(self, name: str) -> int:
         return self.counters.get(name, 0)
@@ -256,8 +289,9 @@ class MetricsRegistry:
         `predict_rows_per_sec` serving metric (bench.py --predict), the
         "predict" latency reservoir, and, when an iteration record is
         open (predict during training), the per-iteration totals."""
-        self.predict_rows_total += int(rows)
-        self.predict_seconds_total += float(seconds)
+        with self._mutex:
+            self.predict_rows_total += int(rows)
+            self.predict_seconds_total += float(seconds)
         self.note_latency("predict", seconds)
         cur = self._current
         if self.enabled and cur is not None:
@@ -285,11 +319,31 @@ class MetricsRegistry:
     @staticmethod
     def device_memory_stats() -> Optional[Dict[str, Any]]:
         """device.memory_stats() of the default device, when the backend
-        provides it (TPU/GPU do; CPU returns None)."""
+        provides it (TPU/GPU do; CPU returns None). Single-device compat
+        entry — multi-chip consumers use per_device_memory_stats."""
         try:
             import jax
             stats = jax.local_devices()[0].memory_stats()
             return dict(stats) if stats else None
+        except Exception:
+            return None
+
+    @staticmethod
+    def per_device_memory_stats() -> Optional[List[Dict[str, Any]]]:
+        """memory_stats() of EVERY local device (each dict carries a
+        "device" ordinal), or None when the backend reports none —
+        sharded runs peak on whichever device holds the fattest shard,
+        which device 0 alone cannot see."""
+        try:
+            import jax
+            out = []
+            for i, dev in enumerate(jax.local_devices()):
+                stats = dev.memory_stats()
+                if stats:
+                    d = dict(stats)
+                    d["device"] = i
+                    out.append(d)
+            return out or None
         except Exception:
             return None
 

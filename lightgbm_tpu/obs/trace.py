@@ -112,6 +112,7 @@ class Tracer:
         self._dropped_events = 0
         # completed spans: (name, start_ns, dur_ns, self_ns, depth, tid)
         self._events: List[tuple] = []
+        self._thread_names: Dict[int, str] = {}  # tid -> thread name
         self._agg: Dict[str, List[float]] = {}  # name -> [total, self, count]
         self._sinks: List[Any] = []  # callables(name, dur_s, self_s)
         self._exported = False
@@ -149,6 +150,7 @@ class Tracer:
         self._stack.clear()
         with self._lock:
             self._events.clear()
+            self._thread_names.clear()
             self._agg.clear()
             self._dropped_events = 0
         self._exported = False
@@ -170,10 +172,14 @@ class Tracer:
 
     def _record(self, name: str, start_ns: int, dur_ns: int, self_ns: int,
                 depth: int) -> None:
+        tid = threading.get_ident()
         with self._lock:
+            if tid not in self._thread_names:
+                # for the thread_name metadata events in chrome_events
+                self._thread_names[tid] = threading.current_thread().name
             if len(self._events) < self.MAX_EVENTS:
                 self._events.append((name, start_ns, dur_ns, self_ns,
-                                     depth, threading.get_ident()))
+                                     depth, tid))
             else:
                 self._dropped_events += 1
             agg = self._agg.get(name)
@@ -203,13 +209,52 @@ class Tracer:
                          f"x{int(s[name]['count'])}")
         return "\n".join(lines)
 
+    def _metadata_events(self, pid: int, tids,
+                         thread_names: Dict[int, str]) -> List[Dict[str, Any]]:
+        """Chrome ``ph: "M"`` metadata: process_name / process_labels
+        (host + shard identity from hostenv / the metrics meta) and a
+        thread_name per recorded thread — without these, multi-thread
+        and multi-process traces are anonymous pid/tid soup in
+        Perfetto."""
+        from ..hostenv import host_labels
+        labels = host_labels()
+        proc = "lightgbm_tpu"
+        if "process_index" in labels:
+            proc += (f" host{labels['process_index']}"
+                     f"/{labels.get('num_processes', '?')}")
+        try:  # shard labels (set by parallel learner setup)
+            from .metrics import global_metrics
+            mesh = global_metrics.meta.get("mesh_size")
+            if mesh:
+                labels["mesh_size"] = str(mesh)
+            learner = global_metrics.meta.get("tree_learner")
+            if learner:
+                labels["tree_learner"] = str(learner)
+        except Exception:
+            pass
+        events = [
+            {"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": proc}},
+            {"name": "process_labels", "ph": "M", "pid": pid,
+             "args": {"labels": ",".join(
+                 f"{k}={v}" for k, v in sorted(labels.items()))}},
+        ]
+        for tid in sorted(tids):
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": thread_names.get(tid, f"thread-{tid}")}})
+        return events
+
     def chrome_events(self) -> List[Dict[str, Any]]:
         """Completed spans as Chrome trace-event dicts (phase "X",
-        microsecond timestamps), sorted by start time."""
+        microsecond timestamps), sorted by start time — prefixed with
+        the ``ph: "M"`` process/thread metadata events."""
         pid = os.getpid()
         with self._lock:
             snapshot = list(self._events)
-        events = []
+            names = dict(self._thread_names)
+        events = self._metadata_events(pid, {e[5] for e in snapshot}
+                                       | set(names), names)
         for name, start_ns, dur_ns, self_ns, depth, tid in sorted(
                 snapshot, key=lambda e: e[1]):
             events.append({

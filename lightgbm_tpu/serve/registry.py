@@ -99,9 +99,13 @@ class ModelRegistry:
     """
 
     def __init__(self, max_pack_bytes: int = 1 << 30,
-                 lowlat_max_rows: int = 64):
+                 lowlat_max_rows: int = 64,
+                 predict_chunk_rows: int = 1 << 20):
         self.max_pack_bytes = int(max_pack_bytes)
         self.lowlat_max_rows = int(lowlat_max_rows)
+        # serving chunk size (tpu_predict_chunk) — what the memory
+        # preflight sizes the per-dispatch working set with
+        self.predict_chunk_rows = int(predict_chunk_rows)
         self._entries: "OrderedDict[str, ServedModel]" = OrderedDict()
 
     # ------------------------------------------------------------------
@@ -130,7 +134,37 @@ class ModelRegistry:
             old.drop_packs()
         entry = ServedModel(name, model, self.lowlat_max_rows)
         self._entries[name] = entry
+        self._preflight(entry)
         return entry
+
+    def _preflight(self, entry: ServedModel) -> None:
+        """Serving-side memory preflight (obs/memory.py): predicted
+        pack + chunk working set vs device capacity, counting the packs
+        OTHER models already hold resident. Warn-only — a registry must
+        keep serving its existing tenants even if a new load looks too
+        big (the LRU budget will evict before the device OOMs)."""
+        try:
+            from ..obs import memory as obs_memory
+            model = entry.model
+            trees = model.trees
+            if not trees:
+                return
+            report = obs_memory.preflight_predict(
+                num_rows=self.predict_chunk_rows,
+                num_features=int(model.max_feature_idx) + 1,
+                num_trees=len(trees),
+                num_leaves=max(int(t.num_leaves) for t in trees),
+                num_class=int(model.num_tree_per_iteration),
+                chunk_rows=self.predict_chunk_rows,
+                resident_pack_bytes=sum(
+                    e.pack_bytes() for e in self._entries.values()
+                    if e is not entry))
+            if report.fits is False:
+                from .. import log
+                log.warning(f"serve memory preflight for model "
+                            f"'{entry.name}': " + report.render())
+        except Exception:
+            pass  # preflight must never block a model load
 
     def get(self, name: str) -> ServedModel:
         """Look up a model (counts a registry hit/miss, bumps to MRU)."""

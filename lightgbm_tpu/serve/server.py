@@ -200,7 +200,8 @@ def serve_file(input_model: str, data_path: str, output_result: str,
     data, _label, _w, _g = load_svmlight_or_csv(data_path,
                                                 dict(params or {}))
     registry = ModelRegistry(max_pack_bytes=cfg.serve_cache_bytes,
-                             lowlat_max_rows=cfg.serve_lowlat_max_rows)
+                             lowlat_max_rows=cfg.serve_lowlat_max_rows,
+                             predict_chunk_rows=cfg.tpu_predict_chunk)
     entry = registry.load("default", model_file=input_model)
     data = conform_prediction_data(np.asarray(data, np.float64),
                                    entry.model.max_feature_idx + 1,

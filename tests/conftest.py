@@ -30,7 +30,7 @@ QUICK_FILES = {
     "test_metrics.py", "test_model_io.py", "test_learner.py",
     "test_booster_surface.py", "test_ingestion.py", "test_waved.py",
     "test_predict_engine.py", "test_serve.py", "test_codegen.py",
-    "test_bin_pack.py", "test_perf_gate.py",
+    "test_bin_pack.py", "test_perf_gate.py", "test_memory_model.py",
 }
 
 

@@ -1,0 +1,446 @@
+"""Analytic peak-HBM model, per-phase watermarks, and the preflight
+capacity planner (obs/memory.py) — plus the booster/registry hooks and
+the perf-gate memory-ceiling wiring (ISSUE 8)."""
+
+import json
+import os
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs import memory as obs_memory
+from lightgbm_tpu.obs.memory import (PhaseWatermarks, PreflightError,
+                                     predict_memory_model, preflight,
+                                     preflight_predict, train_memory_model)
+from lightgbm_tpu.obs.metrics import global_metrics
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from conftest import make_binary  # noqa: E402
+
+BASE = dict(num_data=1_000_000, num_features=28, max_bins=63,
+            num_leaves=255, num_class=1, num_iterations=10,
+            pack_vpb=1, quantized=False, fused_grad=False,
+            kernel_fused=False, waved=True, wave_max=42, num_shards=1)
+
+
+# ---------------------------------------------------------------------------
+class TestTrainModel:
+    def test_peak_is_max_phase_and_covers_persistent(self):
+        m = train_memory_model(**BASE)
+        assert m["peak_bytes"] == max(m["phases"].values())
+        assert m["phases"][m["peak_phase"]] == m["peak_bytes"]
+        assert m["peak_bytes"] >= m["persistent_bytes"]
+        assert all(v >= 0 for v in m["components"].values())
+
+    def test_bin_packing_shrinks_bin_component(self):
+        # 4-bit packing halves (modulo PACK_ALIGN padding), 2-bit quarters
+        unpacked = train_memory_model(**{**BASE, "max_bins": 15})
+        packed = train_memory_model(**{**BASE, "max_bins": 15,
+                                       "pack_vpb": 2})
+        assert packed["components"]["bins"] < \
+            0.55 * unpacked["components"]["bins"]
+        quarter = train_memory_model(**{**BASE, "max_bins": 3,
+                                        "pack_vpb": 4})
+        assert quarter["components"]["bins"] < \
+            0.30 * unpacked["components"]["bins"]
+
+    def test_uint16_storage_above_256_bins(self):
+        wide = train_memory_model(**{**BASE, "max_bins": 300})
+        base = train_memory_model(**BASE)
+        assert wide["components"]["bins"] == 2 * base["components"]["bins"]
+
+    def test_fused_grad_drops_gradient_buffers(self):
+        mat = train_memory_model(**BASE)
+        fused = train_memory_model(**{**BASE, "fused_grad": True})
+        assert mat["components"]["gradients"] == \
+            2 * BASE["num_data"] * 4  # grad + hess f32
+        assert fused["components"]["gradients"] == 0
+        assert fused["peak_bytes"] < mat["peak_bytes"]
+        # kernel-level fusion additionally never materializes ghT
+        kf = train_memory_model(**{**BASE, "fused_grad": True,
+                                   "kernel_fused": True})
+        assert kf["components"]["ght"] == 0
+        assert kf["peak_bytes"] < fused["peak_bytes"]
+
+    def test_quantized_ght_is_int8(self):
+        f32 = train_memory_model(**BASE)
+        q = train_memory_model(**{**BASE, "quantized": True})
+        assert q["components"]["ght"] * 4 == f32["components"]["ght"]
+
+    def test_shards_divide_row_state_not_replicated_state(self):
+        one = train_memory_model(**BASE)
+        four = train_memory_model(**{**BASE, "num_shards": 4})
+        for row_comp in ("bins", "scores", "ght", "row_leaf"):
+            assert four["components"][row_comp] <= \
+                -(-one["components"][row_comp] // 4) + 64
+        # histogram pool and records are replicated per shard
+        assert four["components"]["hist_pool"] == \
+            one["components"]["hist_pool"]
+        assert four["components"]["records"] == one["components"]["records"]
+        assert four["peak_bytes"] < one["peak_bytes"]
+
+    def test_monotone_in_shape(self):
+        base = train_memory_model(**BASE)
+        assert train_memory_model(
+            **{**BASE, "num_data": 2 * BASE["num_data"]})["peak_bytes"] \
+            > base["peak_bytes"]
+        assert train_memory_model(
+            **{**BASE, "num_leaves": 511})["components"]["hist_pool"] \
+            > base["components"]["hist_pool"]
+        assert train_memory_model(
+            **{**BASE, "max_bins": 127})["components"]["hist_wave"] \
+            > base["components"]["hist_wave"]
+
+    def test_valid_sets_add_bytes(self):
+        v = train_memory_model(**{**BASE, "valid_rows": [500_000]})
+        assert v["components"]["valid"] > 0
+        assert v["peak_bytes"] >= train_memory_model(**BASE)["peak_bytes"]
+
+    def test_params_echoed(self):
+        m = train_memory_model(**BASE)
+        assert m["params"]["num_data"] == BASE["num_data"]
+        assert m["kind"] == "train"
+
+
+# ---------------------------------------------------------------------------
+class TestKnobResolution:
+    """preflight resolves config -> model knobs the way the booster
+    itself does (pack factor, fused/quantized/waved state)."""
+
+    def _model_params(self, params, shape=(100_000, 10), **kw):
+        return preflight(params, shape=shape,
+                         capacity_bytes=1 << 50, **kw).model["params"]
+
+    def test_binary_default_is_fused_and_waved(self):
+        p = self._model_params({"objective": "binary"})
+        assert p["fused_grad"] and p["waved"]
+
+    def test_multiclass_softmax_is_exact_and_unfused(self):
+        p = self._model_params({"objective": "multiclass", "num_class": 4})
+        assert not p["waved"] and not p["fused_grad"]
+        assert p["num_class"] == 4
+
+    def test_goss_keeps_materialized_gradients(self):
+        p = self._model_params({"objective": "binary", "boosting": "goss"})
+        assert not p["fused_grad"]
+
+    def test_pack_factor_follows_max_bin_and_knob(self):
+        assert self._model_params({"max_bin": 15})["pack_vpb"] == 2
+        assert self._model_params({"max_bin": 3})["pack_vpb"] == 4
+        assert self._model_params({"max_bin": 63})["pack_vpb"] == 1
+        assert self._model_params({"max_bin": 15,
+                                   "tpu_bin_pack": "off"})["pack_vpb"] == 1
+        # _maybe_pack_bins refuses whenever tpu_num_shards > 1 is set,
+        # even on the serial learner — the resolver must match
+        assert self._model_params({"max_bin": 15,
+                                   "tpu_num_shards": 4})["pack_vpb"] == 1
+
+    def test_quantized_resolution(self):
+        p = self._model_params({"objective": "binary",
+                                "use_quantized_grad": True})
+        assert p["quantized"] and not p["fused_grad"]
+
+
+# ---------------------------------------------------------------------------
+class TestPreflight:
+    def test_requires_shape(self):
+        with pytest.raises(ValueError):
+            preflight({"objective": "binary"})
+
+    def test_no_capacity_no_verdict(self, monkeypatch):
+        monkeypatch.delenv("LGBM_TPU_HBM_BYTES", raising=False)
+        r = preflight({"objective": "binary"}, shape=(10_000, 8))
+        if obs_memory.device_capacity_bytes() is None:  # CPU backend
+            assert r.fits is None and r.recommendations == []
+
+    def test_fits_with_huge_capacity(self):
+        r = preflight({"objective": "binary"}, shape=(10_000, 8),
+                      capacity_bytes=1 << 50)
+        assert r.fits is True and r.headroom_bytes > 0
+        assert r.recommendations == []
+
+    def test_rejects_with_actionable_recommendation(self):
+        r = preflight({"objective": "binary", "num_leaves": 255,
+                       "max_bin": 63}, shape=(10_500_000, 28),
+                      capacity_bytes=int(0.5e9))
+        assert r.fits is False
+        assert r.recommendations, "a non-fit must carry recommendations"
+        known_knobs = {"tpu_bin_pack", "max_bin", "use_quantized_grad",
+                       "tpu_fused_grad", "tpu_num_shards"}
+        for rec in r.recommendations:
+            assert rec["knob"] in known_knobs
+            assert rec["saves_bytes"] > 0
+            assert rec["peak_bytes"] < r.peak_bytes
+            assert rec["reason"]
+        # sorted by saving, biggest first
+        saves = [rec["saves_bytes"] for rec in r.recommendations]
+        assert saves == sorted(saves, reverse=True)
+        text = r.render()
+        assert "DOES NOT FIT" in text
+        assert r.recommendations[0]["knob"] in text
+
+    def test_bin_pack_recommended_when_knob_off(self):
+        r = preflight({"objective": "binary", "max_bin": 15,
+                       "tpu_bin_pack": "off"}, shape=(10_500_000, 28),
+                      capacity_bytes=int(0.4e9))
+        assert r.fits is False
+        assert any(rec["knob"] == "tpu_bin_pack"
+                   for rec in r.recommendations)
+
+    def test_env_capacity_override(self, monkeypatch):
+        monkeypatch.setenv("LGBM_TPU_HBM_BYTES", str(1 << 50))
+        assert obs_memory.device_capacity_bytes() == 1 << 50
+        r = preflight({"objective": "binary"}, shape=(10_000, 8))
+        assert r.fits is True
+
+
+# ---------------------------------------------------------------------------
+class TestPredictModel:
+    def test_chunk_capped_by_request_rows(self):
+        small = predict_memory_model(num_rows=1000, num_features=28,
+                                     num_trees=100, num_leaves=255)
+        assert small["chunk_rows"] <= 1024
+        big = predict_memory_model(num_rows=1 << 22, num_features=28,
+                                   num_trees=100, num_leaves=255)
+        assert big["chunk_rows"] == 1 << 20
+
+    def test_measured_pack_bytes_override(self):
+        m = predict_memory_model(num_rows=1000, num_features=28,
+                                 num_trees=10, num_leaves=31,
+                                 pack_nbytes=12345)
+        assert m["components"]["pack"] == 2 * 12345
+
+    def test_preflight_predict_recommends_smaller_chunk(self):
+        r = preflight_predict(num_rows=1 << 20, num_features=28,
+                              num_trees=100, num_leaves=255,
+                              capacity_bytes=int(100e6))
+        assert r.fits is False
+        assert any(rec["knob"] == "tpu_predict_chunk"
+                   for rec in r.recommendations)
+        chunk_rec = [rec for rec in r.recommendations
+                     if rec["knob"] == "tpu_predict_chunk"][0]
+        assert chunk_rec["setting"] < 1 << 20
+
+    def test_resident_packs_counted_and_evictable(self):
+        r = preflight_predict(num_rows=1 << 16, num_features=28,
+                              num_trees=50, num_leaves=255,
+                              resident_pack_bytes=int(1e9),
+                              capacity_bytes=int(1e9))
+        assert r.fits is False
+        assert any(rec["knob"] == "serve_cache_bytes"
+                   for rec in r.recommendations)
+
+
+# ---------------------------------------------------------------------------
+class TestBoosterHook:
+    def _ds(self):
+        X, y = make_binary(400, 6)
+        return lgb.Dataset(X, label=y)
+
+    def test_meta_published_always_on(self):
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1}, self._ds(), num_boost_round=1)
+        mm = global_metrics.meta.get("mem_model")
+        assert mm is not None
+        assert global_metrics.meta["mem_peak_model_bytes"] == \
+            mm["peak_bytes"]
+        assert mm["params"]["num_leaves"] == 7
+
+    def test_error_mode_fails_fast(self, monkeypatch):
+        monkeypatch.setenv("LGBM_TPU_HBM_BYTES", "1000")
+        with pytest.raises(PreflightError) as exc:
+            lgb.train({"objective": "binary", "num_leaves": 7,
+                       "verbosity": -1, "tpu_preflight": "error"},
+                      self._ds(), num_boost_round=1)
+        assert "DOES NOT FIT" in str(exc.value)
+
+    def test_warn_mode_trains_anyway(self, monkeypatch, capsys):
+        monkeypatch.setenv("LGBM_TPU_HBM_BYTES", "1000")
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": 0}, self._ds(), num_boost_round=1)
+        assert bst.current_iteration() == 1
+        assert "memory preflight" in capsys.readouterr().out
+
+    def test_off_mode_is_silent(self, monkeypatch, capsys):
+        monkeypatch.setenv("LGBM_TPU_HBM_BYTES", "1000")
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": 0, "tpu_preflight": "off"},
+                  self._ds(), num_boost_round=1)
+        assert "memory preflight" not in capsys.readouterr().out
+        # model still published for the driver even with judging off
+        assert "mem_model" in global_metrics.meta
+
+    def test_booster_model_matches_standalone(self):
+        X, y = make_binary(600, 8)
+        bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=1)
+        kw = bst._gbdt._memory_model_kwargs()
+        assert global_metrics.meta["mem_peak_model_bytes"] == \
+            train_memory_model(**kw)["peak_bytes"]
+
+
+# ---------------------------------------------------------------------------
+class TestRegistryHook:
+    def test_load_warns_but_serves_when_over_capacity(self, monkeypatch,
+                                                      capsys):
+        X, y = make_binary(400, 6)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1}, lgb.Dataset(X, label=y),
+                        num_boost_round=2)
+        monkeypatch.setenv("LGBM_TPU_HBM_BYTES", "1000")
+        from lightgbm_tpu import log
+        log.set_verbosity(0)  # verbosity=-1 above silenced warnings
+        from lightgbm_tpu.serve import ModelRegistry
+        reg = ModelRegistry()
+        entry = reg.load("m", booster=bst)
+        out = capsys.readouterr().out
+        assert "serve memory preflight" in out
+        # warn-only: the model is registered and predicts
+        pred = entry.predict_raw(X[:4])
+        assert pred.shape[0] == 4
+
+
+# ---------------------------------------------------------------------------
+class TestWatermarks:
+    def _stats(self, peaks):
+        it = iter(peaks)
+
+        def fn():
+            v = next(it, None)
+            if v is None:
+                return None
+            return [{"peak_bytes_in_use": v, "bytes_in_use": v // 2,
+                     "device": 0}]
+        return fn
+
+    def test_attributes_peak_growth_to_closing_phase(self):
+        wm = PhaseWatermarks(stats_fn=self._stats([100, 300, 300, 900]))
+        assert wm.enable()
+        wm.sink("a", 0.0, 0.0)   # baseline sample: no prior => no delta
+        wm.sink("b", 0.0, 0.0)   # +200 attributed to b
+        wm.sink("b", 0.0, 0.0)   # flat
+        wm.sink("c", 0.0, 0.0)   # +600 attributed to c
+        s = wm.summary()
+        assert s["a"]["delta_bytes"] == 0
+        assert s["b"]["delta_bytes"] == 200 and s["b"]["samples"] == 2
+        assert s["c"]["delta_bytes"] == 600
+        assert s["c"]["peak_bytes"] == 900
+
+    def test_multi_device_takes_max_peak(self):
+        def fn():
+            return [{"peak_bytes_in_use": 100, "bytes_in_use": 50},
+                    {"peak_bytes_in_use": 700, "bytes_in_use": 60}]
+        wm = PhaseWatermarks(stats_fn=fn)
+        wm.enable()
+        wm.sink("x", 0.0, 0.0)
+        s = wm.summary()
+        assert s["x"]["peak_bytes"] == 700
+        assert s["x"]["bytes_in_use"] == 110  # fleet sum
+
+    def test_unsupported_backend_disarms_after_one_probe(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            return None
+        wm = PhaseWatermarks(stats_fn=fn)
+        wm.enable()
+        wm.sink("a", 0.0, 0.0)
+        assert not wm.enabled  # disarmed for good
+        wm.sink("a", 0.0, 0.0)
+        assert len(calls) == 1  # later spans are the O(1) flag check
+        assert not wm.enable()  # re-enable refuses on a probed-off backend
+
+    def test_disabled_sink_is_noop(self):
+        wm = PhaseWatermarks(stats_fn=lambda: [{"peak_bytes_in_use": 1}])
+        wm.sink("a", 0.0, 0.0)
+        assert wm.summary() == {}
+
+    def test_global_watermarks_registered_on_tracer(self):
+        from lightgbm_tpu.obs.memory import global_watermarks
+        from lightgbm_tpu.obs.trace import global_tracer
+        assert global_watermarks.sink in global_tracer._sinks
+
+
+# ---------------------------------------------------------------------------
+class TestGateWiring:
+    def _gate(self):
+        import check_perf_gate
+        return check_perf_gate
+
+    def test_memory_ceiling_passes_on_repo_floor(self, capsys):
+        gate = self._gate()
+        with open(gate.FLOOR_PATH) as fh:
+            floor = json.load(fh)
+        failures = []
+        gate.check_memory_model(floor, failures)
+        assert failures == []
+        assert "memory model" in capsys.readouterr().out
+
+    def test_memory_ceiling_trips_on_regression(self):
+        gate = self._gate()
+        with open(gate.FLOOR_PATH) as fh:
+            floor = json.load(fh)
+        floor["memory"]["max_peak_model_bytes"] //= 2
+        failures = []
+        gate.check_memory_model(floor, failures)
+        assert failures and "peak-memory model regressed" in failures[0]
+
+    def test_model_vs_measured_band(self):
+        gate = self._gate()
+        with open(gate.FLOOR_PATH) as fh:
+            floor = json.load(fh)
+        rec = {"metric": "boosting_iters_per_sec_higgs_shape",
+               "value": 1.0, "vs_baseline": 1.0, "unit": "iters/sec",
+               "mem_peak_model_bytes": int(1e9),
+               "mem_peak_measured_bytes": int(4e9)}  # 0.25x: out of band
+        failures = []
+        gate.check_memory_model(floor, failures, rec)
+        assert failures and "band" in failures[0]
+        # inside the band passes
+        rec["mem_peak_measured_bytes"] = int(1.2e9)
+        failures = []
+        gate.check_memory_model(floor, failures, rec)
+        assert failures == []
+
+    def test_gate_main_accepts_accelerator_candidate(self, tmp_path):
+        """End-to-end through main(): a candidate carrying an in-band
+        model/measured pair passes; an out-of-band pair fails."""
+        gate = self._gate()
+        rec = {"metric": "boosting_iters_per_sec_higgs_shape",
+               "value": 50.0, "vs_baseline": 13.0,
+               "unit": "iters/sec (N=10500000)",
+               "hist_bytes_reduction": 1.35,
+               "mem_peak_model_bytes": int(1e9),
+               "mem_peak_measured_bytes": int(1.2e9)}
+        cand = tmp_path / "BENCH_candidate.json"
+        cand.write_text(json.dumps(rec))
+        assert gate.main([str(cand)]) == 0
+        rec["mem_peak_measured_bytes"] = int(9e9)
+        cand.write_text(json.dumps(rec))
+        assert gate.main([str(cand)]) == 1
+
+
+# ---------------------------------------------------------------------------
+@pytest.mark.skipif(
+    jax.default_backend() == "cpu",
+    reason="memory_stats() is None on CPU; model-vs-measured needs HBM")
+def test_model_within_band_of_measured_on_accelerator():
+    """Acceptance: on TPU/GPU the analytic model is within 1.5x of the
+    measured peak for the fixture shape."""
+    X, y = make_binary(200_000, 28)
+    bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                     "verbosity": -1}, lgb.Dataset(X, label=y),
+                    num_boost_round=3)
+    jax.block_until_ready(bst._gbdt.scores)
+    modeled = global_metrics.meta["mem_peak_model_bytes"]
+    measured = obs_memory.measured_peak_bytes()
+    assert measured is not None
+    ratio = modeled / measured
+    assert 1 / 1.5 <= ratio <= 1.5, (modeled, measured)

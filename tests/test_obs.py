@@ -74,15 +74,72 @@ class TestTracer:
         tr.export_chrome(path)
         with open(path) as fh:
             doc = json.load(fh)  # loadable JSON
-        events = doc["traceEvents"]
-        assert len(events) == 2
-        for ev in events:
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert len(spans) == 2
+        for ev in spans:
             assert isinstance(ev["name"], str)
-            assert ev["ph"] == "X"
             assert ev["ts"] >= 0 and ev["dur"] >= 0
         # checker accepts it
         ok, msg = check_trace(path)
         assert ok, msg
+
+    def test_chrome_metadata_events(self, tmp_path):
+        """Perfetto readability: the export carries ph:"M" process/thread
+        naming — process_name, host/pid process_labels, and a
+        thread_name for every recorded thread."""
+        import threading
+        tr = Tracer()
+        tr.enable()
+        with tr.span("main_phase"):
+            pass
+
+        # record a span from a named worker thread
+        def worker():
+            with tr.span("worker_phase"):
+                pass
+        t = threading.Thread(target=worker, name="lgbm-worker")
+        t.start()
+        t.join()
+        events = tr.chrome_events()
+        meta = [e for e in events if e["ph"] == "M"]
+        by_name = {}
+        for e in meta:
+            by_name.setdefault(e["name"], []).append(e)
+        assert by_name["process_name"][0]["args"]["name"].startswith(
+            "lightgbm_tpu")
+        labels = by_name["process_labels"][0]["args"]["labels"]
+        assert "hostname=" in labels and "pid=" in labels
+        thread_names = {e["args"]["name"] for e in by_name["thread_name"]}
+        assert "lgbm-worker" in thread_names
+        # metadata precedes spans and the validator enforces it
+        assert events[0]["ph"] == "M"
+        path = str(tmp_path / "trace.json")
+        tr.export_chrome(path)
+        ok, msg = check_trace(path)
+        assert ok, msg
+        assert "metadata" in msg
+
+    def test_check_trace_requires_metadata_from_our_producer(self,
+                                                            tmp_path):
+        doc = {"traceEvents": [
+            {"name": "a", "ph": "X", "ts": 1, "dur": 2, "pid": 7,
+             "tid": 9}],
+            "otherData": {"producer": "lightgbm_tpu.obs.trace"}}
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps(doc))
+        ok, msg = check_trace(str(p))
+        assert not ok and "process_name" in msg
+        # foreign traces without metadata stay acceptable
+        doc.pop("otherData")
+        p.write_text(json.dumps(doc))
+        ok, _ = check_trace(str(p))
+        assert ok
+        # malformed metadata payload is rejected everywhere
+        doc["traceEvents"].insert(0, {"name": "thread_name", "ph": "M",
+                                      "args": {}})
+        p.write_text(json.dumps(doc))
+        ok, msg = check_trace(str(p))
+        assert not ok and "args.name" in msg
 
     def test_check_trace_rejects_garbage(self, tmp_path):
         p = tmp_path / "bad.json"
@@ -177,6 +234,63 @@ class TestMetrics:
         assert m.collective_calls == 2
         assert m.collective_bytes == 4096 + 128
         assert m.trace_counts["collective/psum"] == 1
+
+    def test_concurrent_recording_is_lossless(self):
+        """Regression for the unsynchronized read-modify-write in
+        LatencyReservoir.note / inc_counter / note_predict: serve/
+        records from the asyncio loop AND its executor thread, so
+        concurrent notes must not lose updates."""
+        import threading
+        m = MetricsRegistry()
+        threads, per_thread = 8, 2000
+
+        def hammer(tid):
+            for i in range(per_thread):
+                m.note_latency("serve/request", 0.001 * (tid + 1))
+                m.inc_counter("serve/requests")
+                m.note_predict(rows=3, seconds=0.002)
+
+        ts = [threading.Thread(target=hammer, args=(t,))
+              for t in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        total = threads * per_thread
+        res = m.latency("serve/request")
+        assert res.count == total
+        assert m.counter("serve/requests") == total
+        assert m.predict_rows_total == 3 * total
+        assert m.latency("predict").count == total
+        assert m.predict_seconds_total == pytest.approx(0.002 * total)
+        # reservoir stayed bounded and readable
+        assert len(res._samples) == min(total, res.capacity)
+        assert res.summary()["count"] == total
+
+    def test_per_device_memory_stats_shape(self):
+        """Per-device stats: None on CPU (no memory_stats), a list of
+        per-ordinal dicts on accelerator backends — end_iteration folds
+        sum/max so multi-chip runs don't under-report peak."""
+        stats = MetricsRegistry.per_device_memory_stats()
+        if stats is None:
+            return  # CPU backend under conftest
+        assert all("device" in s for s in stats)
+        assert [s["device"] for s in stats] == sorted(
+            s["device"] for s in stats)
+
+    def test_end_iteration_folds_max_and_sum(self, monkeypatch):
+        m = MetricsRegistry()
+        m.enabled = True
+        fake = [{"device": 0, "bytes_in_use": 10, "peak_bytes_in_use": 40},
+                {"device": 1, "bytes_in_use": 30, "peak_bytes_in_use": 90}]
+        monkeypatch.setattr(MetricsRegistry, "per_device_memory_stats",
+                            staticmethod(lambda: fake))
+        m.begin_iteration(0)
+        m.end_iteration()
+        snap = m.snapshot()
+        assert snap["device_bytes_in_use"] == 40       # fleet sum
+        assert snap["device_peak_bytes_in_use"] == 90  # worst device
+        assert snap["device_peak_bytes_per_device"] == [40, 90]
 
     def test_phase_sink_uses_self_time(self):
         m = MetricsRegistry()

@@ -75,6 +75,56 @@ def test_gate_fails_on_throughput_drop(tmp_path, capsys):
     assert "dropped" in capsys.readouterr().out
 
 
+def test_gate_includes_memory_ceiling(capsys):
+    """The gate now recomputes the analytic peak-memory model against
+    the recorded ceiling (ISSUE 8) — its line must appear in a passing
+    run, and the floor file must carry the memory section."""
+    assert check_perf_gate.main([]) == 0
+    assert "memory model" in capsys.readouterr().out
+    with open(check_perf_gate.FLOOR_PATH) as fh:
+        floor = json.load(fh)
+    assert floor["memory"]["max_peak_model_bytes"] > 0
+    assert floor["memory"]["model_vs_measured_band"] == 1.5
+
+
+def test_phase_trajectory_flags_regression():
+    """A phase that blew past its recorded floor fails; phases below
+    the absolute-noise floor are ignored."""
+    with open(check_perf_gate.FLOOR_PATH) as fh:
+        floor = json.load(fh)
+    lines = [
+        ("BENCH_a.json", {"unit": "iters/sec (platform=cpu)",
+                          "phases": {"train/iteration": 1.0,
+                                     "tiny": 0.01}}),
+        ("BENCH_b.json", {"unit": "iters/sec (platform=cpu)",
+                          "phases": {"train/iteration": 2.0,
+                                     "tiny": 0.09}}),
+    ]
+    failures = []
+    check_perf_gate.check_phase_trajectory(floor, failures, lines)
+    assert len(failures) == 1 and "train/iteration" in failures[0]
+
+    ok_lines = [
+        ("BENCH_a.json", {"unit": "iters/sec (platform=cpu)",
+                          "phases": {"train/iteration": 1.0}}),
+        ("BENCH_b.json", {"unit": "iters/sec (platform=cpu)",
+                          "phases": {"train/iteration": 1.2}}),
+    ]
+    failures = []
+    check_perf_gate.check_phase_trajectory(floor, failures, ok_lines)
+    assert failures == []
+
+
+def test_phase_trajectory_skips_without_summaries(capsys):
+    with open(check_perf_gate.FLOOR_PATH) as fh:
+        floor = json.load(fh)
+    failures = []
+    check_perf_gate.check_phase_trajectory(
+        floor, failures, [("BENCH_a.json", {"unit": "iters/sec"})])
+    assert failures == []
+    assert "skipped" in capsys.readouterr().out
+
+
 def test_gate_parses_driver_wrapper_shape():
     """The driver stores bench output as {"n","cmd","rc","tail"}; the
     gate must dig the contract line out of `tail`."""

@@ -2,7 +2,7 @@
 """Perf-regression gate (ROADMAP item 4: convert "should be fast" into
 driver-visible proof).
 
-Two checks, both against the recorded floor in tools/perf_floor.json:
+Four checks, all against the recorded floor in tools/perf_floor.json:
 
 1. **Histogram traffic model** — recomputes the static per-iteration
    HBM byte model (learner.hist_traffic_model) for the recorded
@@ -14,12 +14,28 @@ Two checks, both against the recorded floor in tools/perf_floor.json:
    bin packing, or fattens the gh operand trips this without any
    hardware in the loop.
 
-2. **Bench trajectory** — reads the BENCH_*.json lines in the repo
+2. **Peak-memory model ceiling** — recomputes the analytic peak-HBM
+   model (obs.memory.train_memory_model) for the recorded bench
+   fixture and fails if the predicted peak grew more than 10% over the
+   recorded ceiling (a silently-fattened resident buffer class). A
+   candidate JSON carrying BOTH `mem_peak_model_bytes` and
+   `mem_peak_measured_bytes` (accelerator runs) is additionally held
+   to the recorded model-vs-measured band (1.5x either way) — the
+   out-of-core streaming work needs a fit/doesn't-fit oracle it can
+   trust.
+
+3. **Bench trajectory** — reads the BENCH_*.json lines in the repo
    root (plus an optional candidate JSON passed as argv[1]); for each
    platform the best recorded `vs_baseline` is the floor, and the
    LATEST same-platform value must not drop more than 10% below it.
    A candidate JSON carrying `hist_bytes_per_iter` is additionally
    held to the byte floor.
+
+4. **Phase-time trajectory** — over the obs phase summaries bench.py
+   folds into its JSON line when telemetry is on (`phases`): per
+   platform, a phase above the absolute-noise floor may not exceed its
+   best (lowest) recorded time by the configured fraction. No recorded
+   phase summaries => the check reports itself skipped.
 
 Exit 0 = gate passed; exit 1 = regression, with one line per failure.
 Wired into the quick verification tier via tests/test_perf_gate.py.
@@ -116,8 +132,84 @@ def check_traffic_model(floor, failures):
     return actual
 
 
-def check_bench_trajectory(floor, failures, candidate_path=None):
-    lines = _load_bench_lines(candidate_path)
+def check_memory_model(floor, failures, candidate_rec=None):
+    """Analytic peak-HBM ceiling + model-vs-measured band (check 2)."""
+    from lightgbm_tpu.obs.memory import train_memory_model
+    mem = floor.get("memory")
+    if not mem:
+        print("# no memory floor recorded; memory check skipped")
+        return
+    model = train_memory_model(**mem["fixture"])
+    peak = model["peak_bytes"]
+    ceiling = mem["max_peak_model_bytes"] * 1.10
+    if peak > ceiling:
+        failures.append(
+            f"peak-memory model regressed: {peak / 1e9:.3f} GB "
+            f"> floor {mem['max_peak_model_bytes'] / 1e9:.3f} GB (+10%)")
+    print(f"# memory model: {peak / 1e9:.3f} GB predicted peak "
+          f"(phase: {model['peak_phase']})")
+    if not candidate_rec:
+        return
+    modeled = candidate_rec.get("mem_peak_model_bytes")
+    measured = candidate_rec.get("mem_peak_measured_bytes")
+    if not modeled or not measured:
+        return  # CPU runs carry no measured peak
+    band = float(mem.get("model_vs_measured_band", 1.5))
+    ratio = modeled / measured
+    if ratio > band or ratio < 1.0 / band:
+        failures.append(
+            f"memory model {modeled / 1e9:.3f} GB is outside the "
+            f"{band}x band of measured peak {measured / 1e9:.3f} GB "
+            f"(ratio {ratio:.2f})")
+    else:
+        print(f"# memory model vs measured: {ratio:.2f}x "
+              f"(band {1 / band:.2f}..{band:.2f})")
+
+
+def check_phase_trajectory(floor, failures, lines):
+    """Per-phase obs time summaries in BENCH lines (check 4): the
+    latest same-platform run's phase seconds may not exceed the best
+    (lowest) recorded value by more than the configured fraction, for
+    phases above the absolute-noise floor — the ROADMAP item-4 gate
+    over *where* iteration time goes, not just the headline rate."""
+    cfg = floor.get("phases") or {}
+    max_inc = float(cfg.get("max_seconds_increase", 0.5))
+    min_abs = float(cfg.get("min_abs_seconds", 0.1))
+    by_platform = {}
+    for tag, rec in lines:
+        phases = rec.get("phases")
+        if isinstance(phases, dict) and phases:
+            by_platform.setdefault(
+                _platform_of(rec.get("unit", "")), []).append((tag, phases))
+    if not by_platform:
+        print("# no obs phase summaries recorded; phase check skipped")
+        return
+    for platform, recs in by_platform.items():
+        tag, latest = recs[-1]
+        checked = 0
+        for name, seconds in latest.items():
+            if not isinstance(seconds, (int, float)):
+                continue
+            history = [p[name] for _, p in recs[:-1]
+                       if isinstance(p.get(name), (int, float))]
+            if not history:
+                continue
+            best = min(history)
+            if seconds < min_abs:
+                continue  # latest is below the noise floor
+            # a best below the noise floor is lifted TO the floor, not
+            # exempted: a 0.09s phase regressing to 10s must still trip
+            floor_s = max(best, min_abs)
+            checked += 1
+            if seconds > floor_s * (1.0 + max_inc):
+                failures.append(
+                    f"{tag}: {platform} phase '{name}' took {seconds:.3f}s "
+                    f"> {1 + max_inc:.1f}x recorded floor {floor_s:.3f}s")
+        print(f"# phases[{platform}]: {checked} phase(s) checked "
+              f"against floor ({tag})")
+
+
+def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
         return
@@ -137,7 +229,7 @@ def check_bench_trajectory(floor, failures, candidate_path=None):
         else:
             print(f"# bench[{platform}]: latest {latest:.4f} vs floor "
                   f"{best:.4f} ({tag})")
-    if candidate_path:
+    if candidate_rec:
         # the candidate's absolute bytes depend on its row count and
         # bin width (the driver shrinks N on relay failures; bench's
         # train config is 63-bin/unpacked while the floor fixture is
@@ -145,8 +237,7 @@ def check_bench_trajectory(floor, failures, candidate_path=None):
         # reduction ratio vs its oracle, which is N-invariant. The
         # subtraction-aware schedule + fused gradient pass alone give
         # >= ~1.35 at any config; losing either drops below the floor.
-        rec = lines[-1][1]
-        red = rec.get("hist_bytes_reduction")
+        red = candidate_rec.get("hist_bytes_reduction")
         min_red = float(floor["bench"].get("min_candidate_reduction", 1.3))
         if red is not None and red < min_red:
             failures.append(
@@ -159,9 +250,17 @@ def main(argv=None) -> int:
     candidate = argv[0] if argv else None
     with open(FLOOR_PATH) as fh:
         floor = json.load(fh)
+    # one disk pass: every trajectory check reads the same line list
+    lines = _load_bench_lines(candidate)
+    candidate_rec = None
+    if candidate and lines and \
+            lines[-1][0] == os.path.basename(candidate):
+        candidate_rec = lines[-1][1]
     failures = []
     actual = check_traffic_model(floor, failures)
-    check_bench_trajectory(floor, failures, candidate)
+    check_memory_model(floor, failures, candidate_rec)
+    check_bench_trajectory(floor, failures, lines, candidate_rec)
+    check_phase_trajectory(floor, failures, lines)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")

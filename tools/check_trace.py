@@ -10,7 +10,15 @@ Checks, in order:
   3. every event has the required fields with the right types
      (``name`` str, ``ph`` str, and for complete events ``ph == "X"``:
      numeric non-negative ``ts`` and ``dur``);
-  4. per (pid, tid) track, ``ts`` is monotonically non-decreasing in
+  4. metadata events (``ph == "M"``) named ``process_name`` /
+     ``thread_name`` / ``process_labels`` carry a dict ``args`` with
+     the string payload Perfetto renders (``name`` / ``labels``);
+  5. when the trace declares our exporter as producer
+     (``otherData.producer == "lightgbm_tpu.obs.trace"``), every pid
+     must have a ``process_name`` and every (pid, tid) track with
+     complete spans a ``thread_name`` — multi-thread / multi-process
+     traces are unreadable pid/tid soup without them;
+  6. per (pid, tid) track, ``ts`` is monotonically non-decreasing in
      file order (the exporter sorts by start time; a violation means a
      corrupted or hand-edited trace).
 
@@ -36,17 +44,23 @@ def check_trace(path: str) -> Tuple[bool, str]:
     except json.JSONDecodeError as exc:
         return False, f"{path} is not valid JSON: {exc}"
 
+    our_producer = False
     if isinstance(doc, list):
         events: List[Any] = doc
     elif isinstance(doc, dict):
         events = doc.get("traceEvents")
         if not isinstance(events, list):
             return False, "top-level object has no 'traceEvents' list"
+        our_producer = (doc.get("otherData", {}).get("producer")
+                        == "lightgbm_tpu.obs.trace")
     else:
         return False, f"unexpected top-level JSON type {type(doc).__name__}"
 
+    _META_PAYLOAD = {"process_name": "name", "thread_name": "name",
+                     "process_labels": "labels"}
     last_ts = {}  # (pid, tid) -> ts
-    n_complete = 0
+    named_pids, named_tracks = set(), set()  # from metadata events
+    n_complete = n_meta = 0
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
             return False, f"event {i} is not an object"
@@ -55,6 +69,18 @@ def check_trace(path: str) -> Tuple[bool, str]:
             return False, f"event {i} has no string 'name'"
         if not isinstance(ph, str) or not ph:
             return False, f"event {i} ({name!r}) has no string 'ph'"
+        if ph == "M" and name in _META_PAYLOAD:
+            key = _META_PAYLOAD[name]
+            args = ev.get("args")
+            if not isinstance(args, dict) or \
+                    not isinstance(args.get(key), str) or not args[key]:
+                return False, (f"metadata event {i} ({name!r}) lacks a "
+                               f"string args.{key}")
+            n_meta += 1
+            if name == "process_name":
+                named_pids.add(ev.get("pid"))
+            elif name == "thread_name":
+                named_tracks.add((ev.get("pid"), ev.get("tid")))
         if ph != "X":
             continue  # metadata/counter events need no ts ordering
         n_complete += 1
@@ -69,7 +95,17 @@ def check_trace(path: str) -> Tuple[bool, str]:
             return False, (f"event {i} ({name!r}) breaks ts monotonicity "
                            f"on track {track}: {ts} < {prev}")
         last_ts[track] = ts
-    return True, f"ok: {n_complete} complete spans on {len(last_ts)} track(s)"
+    if our_producer and n_complete:
+        for pid, tid in last_ts:
+            if pid not in named_pids:
+                return False, (f"trace from lightgbm_tpu.obs.trace lacks a "
+                               f"process_name metadata event for pid {pid}")
+            if (pid, tid) not in named_tracks:
+                return False, (f"trace from lightgbm_tpu.obs.trace lacks a "
+                               f"thread_name metadata event for track "
+                               f"({pid}, {tid})")
+    return True, (f"ok: {n_complete} complete spans on {len(last_ts)} "
+                  f"track(s), {n_meta} metadata event(s)")
 
 
 def main(argv: List[str]) -> int:
