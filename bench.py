@@ -134,9 +134,13 @@ def _run_child(rows: int, platform: str, timeout: float,
     rc = -1
     try:
         with open(err_path, "w") as err_fh:
+            # child stdout rides the same filtered channel as stderr:
+            # the ONE contract line travels via BENCH_OUT, so anything a
+            # child prints (tracer exit dumps, partial obs summaries)
+            # must never reach the driver's stdout directly
             proc = subprocess.Popen(
                 [sys.executable, os.path.abspath(__file__)],
-                env=env, stderr=err_fh)
+                env=env, stdout=err_fh, stderr=err_fh)
             try:
                 rc = proc.wait(timeout=timeout)
             except subprocess.TimeoutExpired:
@@ -263,11 +267,15 @@ def _measure():
     if telemetry:
         # record spans for the phase-time summary folded into the JSON
         # line below (export/exit-print still follow the env knobs),
-        # and arm the span-boundary HBM watermark sampler (no-op on CPU)
+        # arm the span-boundary HBM watermark sampler (no-op on CPU),
+        # and the XLA introspector (compile time + cost analysis per
+        # program boundary)
         from lightgbm_tpu.obs import global_tracer
         from lightgbm_tpu.obs.memory import global_watermarks
+        from lightgbm_tpu.obs.xla import global_xla
         global_tracer.enable()
         global_watermarks.enable()
+        global_xla.enable()
 
     import jax
     # persistent compilation cache: a retried/repeated bench attempt (or
@@ -380,6 +388,13 @@ def _measure():
         for name, agg in global_tracer.summary().items():
             phases[name] = round(agg["seconds"], 4)
         result["phases"] = phases
+        # XLA compile attribution (obs/xla.py): total compile wall-time
+        # and which phase's programs recompiled, per executable
+        from lightgbm_tpu.obs.xla import global_xla
+        xs = global_xla.summary()
+        if xs["n_programs"]:
+            result["compile_s_total"] = xs["compile_s_total"]
+            result["n_recompiles_by_phase"] = xs["n_recompiles_by_phase"]
         # live per-phase HBM watermarks (accelerator backends only —
         # the sampler self-disables where memory_stats() is None)
         from lightgbm_tpu.obs.memory import global_watermarks
@@ -705,8 +720,47 @@ def _measure_serve():
 _MODE_MEASURE = {"train": _measure, "predict": _measure_predict,
                  "serve": _measure_serve}
 
+
+def _emit_partial_obs(mode: str, exc) -> None:
+    """A failed measurement attempt still surfaces its partial obs
+    summary (phase self-times + compile/recompile attribution so far)
+    as one stderr comment line the parent's spam filter forwards — the
+    old path dropped everything a dead child had already measured."""
+    try:
+        partial = {"metric": _MODE_METRIC.get(mode, mode), "partial": True,
+                   "error": repr(exc)[:300]}
+        if _telemetry_enabled():
+            from lightgbm_tpu.obs import global_tracer
+            phases = {name: round(agg["seconds"], 4)
+                      for name, agg in global_tracer.summary().items()}
+            if phases:
+                partial["phases"] = phases
+            from lightgbm_tpu.obs.xla import global_xla
+            xs = global_xla.summary()
+            if xs["n_programs"]:
+                partial["compile_s_total"] = xs["compile_s_total"]
+                partial["n_recompiles_by_phase"] = \
+                    xs["n_recompiles_by_phase"]
+        print("# obs-partial: " + json.dumps(partial), file=sys.stderr,
+              flush=True)
+    except Exception:
+        pass  # the partial dump must never mask the real failure
+
+
+def _child_main() -> None:
+    mode = parse_bench_mode()
+    # the parent's timeout path sends SIGTERM; turn it into SystemExit
+    # so the partial-obs dump below (and atexit handlers) still run
+    signal.signal(signal.SIGTERM, lambda *_: sys.exit(143))
+    try:
+        _MODE_MEASURE[mode]()
+    except BaseException as exc:
+        _emit_partial_obs(mode, exc)
+        raise
+
+
 if __name__ == "__main__":
     if os.environ.get("BENCH_CHILD"):
-        _MODE_MEASURE[parse_bench_mode()]()
+        _child_main()
     else:
         main()

@@ -27,6 +27,8 @@ import jax.numpy as jnp
 from .config import Config
 from .dataset import BinnedDataset
 from .learner import grow_tree, grow_tree_waved, replay_tree
+from .obs import xla as obs_xla
+from .obs.export import global_flusher
 from .obs.metrics import global_metrics
 from .obs.trace import global_tracer
 from .timer import global_timer  # noqa: F401  (compat facade re-export)
@@ -325,8 +327,8 @@ class GBDT:
                                self.config.feature_fraction_bynode < 1.0)
         self._extra_key = jax.random.PRNGKey(self.config.extra_seed)
         self._fused_grad_fn = self._resolve_fused_grad()
-        self._grow = jax.jit(global_metrics.wrap_traced(
-            "boosting/grow", self._grow_partial()))
+        self._grow = obs_xla.instrumented_jit(
+            "boosting/grow", self._grow_partial(), phase="grow")
         self._fused = None
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
@@ -757,9 +759,9 @@ class GBDT:
                 if obj is not None:
                     obj.swap_device_state(old_state)
 
-        return jax.jit(global_metrics.wrap_traced("boosting/fused_iter",
-                                                  fused),
-                       donate_argnums=(3, 4, 5))
+        return obs_xla.instrumented_jit("boosting/fused_iter", fused,
+                                        phase="train",
+                                        donate_argnums=(3, 4, 5))
 
     def _train_one_iter_fast(self) -> bool:
         self._boost_from_average()
@@ -916,6 +918,8 @@ class GBDT:
 
         With telemetry on (obs.metrics), each call opens a per-iteration
         metrics record; disabled mode is a single attribute check."""
+        if global_flusher.armed:  # LGBM_TPU_METRICS_FILE textfile egress
+            global_flusher.maybe_flush()
         if not global_metrics.enabled:
             return self._train_one_iter_impl(custom_grad, custom_hess)
         global_metrics.begin_iteration(self.iter)
@@ -1686,9 +1690,9 @@ class DART(GBDT):
             finally:
                 obj.swap_device_state(old_state)
 
-        return jax.jit(global_metrics.wrap_traced("boosting/fused_dart_iter",
-                                                  fused),
-                       donate_argnums=(3, 4, 5, 6, 7, 8, 9))
+        return obs_xla.instrumented_jit("boosting/fused_dart_iter", fused,
+                                        phase="train",
+                                        donate_argnums=(3, 4, 5, 6, 7, 8, 9))
 
     def _train_one_iter_fast(self) -> bool:
         """Fused DART iteration (the DART twin of the GBDT fast path)."""

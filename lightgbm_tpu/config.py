@@ -187,6 +187,7 @@ _ALIASES: Dict[str, List[str]] = {
     "serve_lowlat_max_rows": ["serve_lowlat_rows"],
     "serve_cache_bytes": ["serve_pack_budget_bytes"],
     "serve_request_rows": [],
+    "serve_metrics_port": ["metrics_port"],
 }
 
 _ALIAS_TO_CANONICAL: Dict[str, str] = {}
@@ -557,11 +558,15 @@ class Config:
     # ensemble bytes the multi-tenant registry keeps resident (LRU pack
     # eviction; 0 = unbounded). serve_request_rows is the CLI replay's
     # rows-per-request (0 = a mixed small/large size cycle).
+    # serve_metrics_port exposes /metrics + /healthz + /readyz on
+    # task=serve (obs/export.py): -1 = off, 0 = ephemeral port (logged
+    # in the stats line), >0 = that port.
     serve_max_batch_rows: int = 8192
     serve_max_wait_ms: float = 2.0
     serve_lowlat_max_rows: int = 64
     serve_cache_bytes: int = 1 << 30
     serve_request_rows: int = 0
+    serve_metrics_port: int = -1
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)

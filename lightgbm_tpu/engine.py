@@ -110,12 +110,16 @@ def _scoped_telemetry_enable(callbacks) -> Callable[[], None]:
     returns a restore function that puts the registry AND the tracer
     (switched on by metrics.enable()) back to their prior state, so the
     opt-in does not outlive the run it was requested for."""
+    from .obs.memory import global_watermarks
     from .obs.metrics import global_metrics
     from .obs.trace import global_tracer
+    from .obs.xla import global_xla
     if not any(getattr(cb, "needs_telemetry", False)
                for cb in (callbacks or [])):
         return lambda: None
     metrics_was, tracer_was = global_metrics.enabled, global_tracer.enabled
+    xla_was = global_xla.enabled
+    watermarks_was = global_watermarks.enabled
     global_metrics.enable()
 
     def restore() -> None:
@@ -123,6 +127,10 @@ def _scoped_telemetry_enable(callbacks) -> Callable[[], None]:
             global_metrics.disable()
             if not tracer_was:
                 global_tracer.disable()
+            if not xla_was:
+                global_xla.disable()
+            if not watermarks_was:
+                global_watermarks.disable()
     return restore
 
 

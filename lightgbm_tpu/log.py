@@ -6,10 +6,19 @@ threshold is driven by Config.verbosity exactly as the reference maps it
 (config.h verbosity: <0 fatal, 0 warning+error, 1 info, >1 debug). A
 custom logger object or callback can be registered, as with
 ``lightgbm.register_logger``.
+
+``LGBM_TPU_LOG_JSON=1`` (or ``set_json_mode(True)``) switches the
+default print path to one JSON object per line — ``ts``/``level``/
+``msg`` plus every ``hostenv.host_labels()`` entry (hostname, pid, and
+the jax.distributed process index when initialized) — so multihost
+logs interleaved from many workers stay machine-mergeable. A registered
+custom logger still receives the plain message (it owns its own
+formatting).
 """
 
 from __future__ import annotations
 
+import os
 from typing import Any, Callable, Optional
 
 FATAL = -1
@@ -25,6 +34,14 @@ _logger: Optional[Any] = None
 _info_method = "info"
 _warning_method = "warning"
 _debug_method: Optional[str] = None
+_json_mode = os.environ.get("LGBM_TPU_LOG_JSON", "") not in ("", "0")
+
+
+def set_json_mode(on: bool) -> None:
+    """Toggle structured JSON log records on the default print path
+    (the runtime twin of the ``LGBM_TPU_LOG_JSON`` env var)."""
+    global _json_mode
+    _json_mode = bool(on)
 
 
 def set_verbosity(verbosity: int) -> None:
@@ -77,6 +94,14 @@ def _emit(level: int, msg: str, force: bool = False) -> None:
         else:
             meth = _info_method
         getattr(_logger, meth)(msg)
+    elif _json_mode:
+        import json
+        import time
+        from .hostenv import host_labels
+        rec = {"ts": round(time.time(), 3),
+               "level": _LEVEL_NAMES[level], "msg": msg}
+        rec.update(host_labels())  # hostname/pid/process_index stamps
+        print(json.dumps(rec), flush=True)
     else:
         print(f"[LightGBM-TPU] [{_LEVEL_NAMES[level]}] {msg}", flush=True)
 

@@ -16,6 +16,14 @@ common.h:980,1044; global_timer dump at src/boosting/gbdt.cpp:29):
   per-phase watermarks sampled at span boundaries
   (``global_watermarks``), and the ``preflight`` capacity planner that
   fails fast (with knob recommendations) instead of OOMing mid-run.
+- ``obs.xla``    — XLA program introspection: per-executable
+  ``cost_analysis()`` / ``memory_analysis()`` capture, compile
+  wall-time, and per-phase/shape-bucket recompile attribution
+  (``instrumented_jit`` at the program boundaries).
+- ``obs.export`` — OpenMetrics egress: the Prometheus text-format
+  renderer over all of the above, the ``/metrics``+``/healthz``+
+  ``/readyz`` HTTP endpoint, and the ``LGBM_TPU_METRICS_FILE``
+  textfile flusher.
 
 All are disabled by default and their hot-path guards are single
 attribute checks — training with telemetry off records nothing and
@@ -29,10 +37,19 @@ from .memory import (PhaseWatermarks, PreflightError,  # noqa: F401
                      PreflightReport, device_capacity_bytes,
                      global_watermarks, predict_memory_model, preflight,
                      preflight_predict, train_memory_model)
+from .xla import (XlaIntrospector, aot_cost_summary,  # noqa: F401
+                  global_xla, instrumented_jit)
+from .export import (MetricsHTTPEndpoint,  # noqa: F401
+                     MetricsTextfileFlusher, global_flusher,
+                     render_openmetrics)
 
 __all__ = ["Tracer", "global_tracer", "LatencyReservoir",
            "MetricsRegistry", "global_metrics",
            "PhaseWatermarks", "PreflightError", "PreflightReport",
            "device_capacity_bytes", "global_watermarks",
            "train_memory_model", "predict_memory_model",
-           "preflight", "preflight_predict"]
+           "preflight", "preflight_predict",
+           "XlaIntrospector", "global_xla", "instrumented_jit",
+           "aot_cost_summary", "MetricsHTTPEndpoint",
+           "MetricsTextfileFlusher", "global_flusher",
+           "render_openmetrics"]

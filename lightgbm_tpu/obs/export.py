@@ -1,0 +1,326 @@
+"""OpenMetrics / Prometheus text-format export of the obs registries.
+
+PR 1–5 accumulated rich internal telemetry (counters, latency
+reservoirs, recompile counts, HBM watermarks, the analytic memory
+model) that nothing could scrape. This module is the egress:
+
+- ``render_openmetrics()`` — one Prometheus text-format (0.0.4)
+  document over ``obs.metrics.global_metrics`` (event counters, latency
+  reservoirs as summary metrics with quantile labels, predict
+  throughput, trace-time jit counters, collective traffic), the
+  per-device HBM stats + ``obs.memory`` watermark/model gauges where
+  available, the ``obs.xla`` compile facts, and host identity labels.
+- ``MetricsHTTPEndpoint`` — a daemon-thread HTTP listener serving
+  ``/metrics`` (the rendered document), ``/healthz`` (process
+  liveness — 200 whenever the listener is up) and ``/readyz`` (503
+  until the owner's ``ready_fn`` turns true; ``ModelServer`` wires its
+  warm()-in-progress state here). stdlib ``http.server`` on a thread,
+  so it keeps answering while the main thread blocks in ``warm()`` or
+  a training step.
+- ``MetricsTextfileFlusher`` — the training-side egress for hosts with
+  a node-exporter textfile collector instead of a scrape target:
+  ``LGBM_TPU_METRICS_FILE=/path.prom`` makes the boosting loop flush
+  the rendered document atomically (tmp + rename) at most every
+  ``LGBM_TPU_METRICS_FLUSH_SECS`` (default 15), plus once at exit.
+
+Disabled cost: with the env var unset, ``global_flusher.maybe_flush()``
+is a single attribute check; nothing renders, nothing is written.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import re
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .metrics import global_metrics
+
+# Prometheus text exposition format 0.0.4 (the content type Prometheus'
+# scraper negotiates for the text format)
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _metric_name(name: str, suffix: str = "") -> str:
+    """`serve/registry_hit` -> `lgbmtpu_serve_registry_hit<suffix>`."""
+    return "lgbmtpu_" + _NAME_OK.sub("_", name).strip("_") + suffix
+
+
+def _label_value(v: Any) -> str:
+    s = str(v)
+    return s.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    return repr(float(v))
+
+
+class _Doc:
+    """Accumulates families in render order, one TYPE header each."""
+
+    def __init__(self) -> None:
+        self.lines: List[str] = []
+        self._typed: set = set()
+
+    def sample(self, family: str, mtype: str, value: Any,
+               labels: Optional[Dict[str, Any]] = None,
+               help_text: str = "", name: Optional[str] = None) -> None:
+        if family not in self._typed:
+            self._typed.add(family)
+            if help_text:
+                self.lines.append(f"# HELP {family} {help_text}")
+            self.lines.append(f"# TYPE {family} {mtype}")
+        n = name or family
+        if labels:
+            lab = ",".join(f'{k}="{_label_value(v)}"'
+                           for k, v in sorted(labels.items()))
+            n += "{" + lab + "}"
+        self.lines.append(f"{n} {_fmt(value)}")
+
+    def text(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def render_openmetrics(registry=None,
+                       extra_gauges: Optional[Dict[str, Any]] = None
+                       ) -> str:
+    """The full obs state as one Prometheus text-format document.
+
+    `extra_gauges` maps already-sanitized family names to values
+    (the ModelServer adds its pack/registry gauges this way)."""
+    reg = registry if registry is not None else global_metrics
+    doc = _Doc()
+
+    # snapshot the concurrently-mutated dicts under the registry mutex:
+    # the serve loop/executor insert new counter and reservoir names
+    # while the HTTP daemon thread renders (a live iteration would
+    # raise "dictionary changed size during iteration" mid-scrape)
+    with reg._mutex:
+        counters = dict(reg.counters)
+        reservoirs = dict(reg.latency_reservoirs)
+    trace_counts = dict(reg.trace_counts)
+    meta = dict(reg.meta)
+
+    # host identity: an info-style gauge (constant 1) carrying labels,
+    # so multihost scrapes are mergeable by labels instead of by target
+    from ..hostenv import host_labels
+    doc.sample("lgbmtpu_host_info", "gauge", 1, labels=host_labels(),
+               help_text="host/process identity labels (value is 1)")
+
+    # flat event counters (serve/* registry + batcher + server events)
+    for name in sorted(counters):
+        doc.sample(_metric_name(name, "_total"), "counter",
+                   counters[name])
+
+    # latency reservoirs -> summary metrics with quantile labels
+    fam = "lgbmtpu_latency_seconds"
+    for name in sorted(reservoirs):
+        res = reservoirs[name]
+        p50, p95, p99 = res.quantiles((0.50, 0.95, 0.99))
+        for q, v in (("0.5", p50), ("0.95", p95), ("0.99", p99)):
+            doc.sample(fam, "summary", v,
+                       labels={"name": name, "quantile": q},
+                       help_text="latency quantiles from the bounded "
+                                 "obs reservoirs")
+        doc.sample(fam, "summary", res.total_seconds,
+                   labels={"name": name}, name=fam + "_sum")
+        doc.sample(fam, "summary", res.count,
+                   labels={"name": name}, name=fam + "_count")
+
+    # predict throughput accumulators (always-on)
+    doc.sample("lgbmtpu_predict_rows_total", "counter",
+               reg.predict_rows_total)
+    doc.sample("lgbmtpu_predict_seconds_total", "counter",
+               reg.predict_seconds_total)
+    doc.sample("lgbmtpu_predict_rows_per_sec", "gauge",
+               reg.predict_rows_per_sec())
+
+    # trace-time jit counters + collective traffic
+    for tag in sorted(trace_counts):
+        doc.sample("lgbmtpu_jit_traces_total", "counter",
+                   trace_counts[tag], labels={"tag": tag},
+                   help_text="python traces per jit tag (one per "
+                             "program (re)compile at top level)")
+    doc.sample("lgbmtpu_collective_calls_total", "counter",
+               reg.collective_calls)
+    doc.sample("lgbmtpu_collective_bytes_total", "counter",
+               reg.collective_bytes)
+
+    # device memory gauges (accelerator backends only)
+    stats = reg.per_device_memory_stats()
+    for s in stats or ():
+        lab = {"device": s.get("device", 0)}
+        for key, fam_name in (("bytes_in_use", "lgbmtpu_device_bytes_in_use"),
+                              ("peak_bytes_in_use",
+                               "lgbmtpu_device_peak_bytes_in_use"),
+                              ("bytes_limit", "lgbmtpu_device_bytes_limit")):
+            if isinstance(s.get(key), (int, float)):
+                doc.sample(fam_name, "gauge", s[key], labels=lab)
+
+    # per-phase HBM watermarks (obs/memory.py; armed on accelerators)
+    from .memory import global_watermarks
+    for phase, ph in sorted(global_watermarks.summary().items()):
+        doc.sample("lgbmtpu_phase_peak_bytes", "gauge", ph["peak_bytes"],
+                   labels={"phase": phase},
+                   help_text="span-boundary HBM peak per phase")
+
+    # analytic-model gauges published through obs meta
+    mm = meta.get("mem_model")
+    if isinstance(mm, dict) and "peak_bytes" in mm:
+        doc.sample("lgbmtpu_mem_peak_model_bytes", "gauge",
+                   mm["peak_bytes"],
+                   help_text="analytic peak-HBM model (obs/memory.py)")
+    ht = meta.get("hist_traffic")
+    if isinstance(ht, dict) and "hist_bytes_per_iter" in ht:
+        doc.sample("lgbmtpu_hist_bytes_per_iter", "gauge",
+                   ht["hist_bytes_per_iter"],
+                   help_text="analytic per-iteration histogram HBM "
+                             "traffic (learner.hist_traffic_model)")
+
+    # XLA introspection (obs/xla.py; populated while enabled)
+    from .xla import global_xla
+    xs = global_xla.summary()
+    doc.sample("lgbmtpu_xla_compile_seconds_total", "counter",
+               xs["compile_s_total"],
+               help_text="wall time spent compiling XLA programs")
+    doc.sample("lgbmtpu_xla_programs_total", "counter", xs["n_programs"])
+    for phase in sorted(xs["n_recompiles_by_phase"]):
+        doc.sample("lgbmtpu_xla_compiles_total", "counter",
+                   xs["n_recompiles_by_phase"][phase],
+                   labels={"phase": phase})
+    for tag in sorted(xs["by_tag"]):
+        t = xs["by_tag"][tag]
+        if "flops" in t:
+            doc.sample("lgbmtpu_xla_flops", "gauge", t["flops"],
+                       labels={"tag": tag},
+                       help_text="XLA cost-analysis flops per compiled "
+                                 "program set")
+        if "bytes_accessed" in t:
+            doc.sample("lgbmtpu_xla_bytes_accessed", "gauge",
+                       t["bytes_accessed"], labels={"tag": tag})
+
+    for fam_name in sorted(extra_gauges or {}):
+        doc.sample(fam_name, "gauge", extra_gauges[fam_name])
+    return doc.text()
+
+
+# ---------------------------------------------------------------------------
+class MetricsHTTPEndpoint:
+    """Daemon-thread HTTP listener for /metrics, /healthz, /readyz.
+
+    `render_fn` produces the /metrics body; `ready_fn` (optional)
+    gates /readyz (False -> 503). Binds `port` (0 = ephemeral; read the
+    chosen one back from ``.port``)."""
+
+    def __init__(self, render_fn: Callable[[], str],
+                 ready_fn: Optional[Callable[[], bool]] = None,
+                 port: int = 0, host: str = "127.0.0.1") -> None:
+        import http.server
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def _send(self, code: int, body: bytes,
+                      ctype: str = "text/plain; charset=utf-8") -> None:
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self) -> None:
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    try:
+                        body = render_fn().encode()
+                    except Exception as exc:
+                        self._send(500, f"render failed: {exc}\n".encode())
+                        return
+                    self._send(200, body, CONTENT_TYPE)
+                elif path == "/healthz":
+                    self._send(200, b"ok\n")
+                elif path == "/readyz":
+                    ready = True if ready_fn is None else bool(ready_fn())
+                    self._send(200 if ready else 503,
+                               b"ready\n" if ready else b"warming\n")
+                else:
+                    self._send(404, b"not found\n")
+
+            def log_message(self, *args) -> None:
+                pass  # scrapes must not spam the training log
+
+        self._httpd = http.server.ThreadingHTTPServer((host, port), Handler)
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="lgbm-metrics-http",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+class MetricsTextfileFlusher:
+    """Periodic atomic flush of the rendered document to a textfile
+    (node-exporter textfile-collector shape). Armed by the
+    ``LGBM_TPU_METRICS_FILE`` env var; ``maybe_flush()`` is the
+    per-iteration hook — one attribute check when unarmed."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._last = 0.0
+        self.rearm()
+
+    def rearm(self) -> None:
+        """Re-read the env knobs (tests toggle them at runtime)."""
+        self.path = os.environ.get("LGBM_TPU_METRICS_FILE", "")
+        self.armed = bool(self.path)
+        try:
+            self.interval_s = float(os.environ.get(
+                "LGBM_TPU_METRICS_FLUSH_SECS", "") or 15.0)
+        except ValueError:
+            self.interval_s = 15.0
+
+    def maybe_flush(self, force: bool = False) -> bool:
+        if not self.armed:
+            return False
+        now = time.monotonic()
+        with self._lock:
+            if not force and now - self._last < self.interval_s:
+                return False
+            self._last = now
+        return self.flush()
+
+    def flush(self) -> bool:
+        if not self.armed:
+            return False
+        try:
+            text = render_openmetrics()
+            tmp = self.path + ".tmp"
+            with open(tmp, "w") as fh:
+                fh.write(text)
+            os.replace(tmp, self.path)  # scrapers never see a torn file
+            return True
+        except Exception:
+            return False  # egress must never take training down
+
+
+global_flusher = MetricsTextfileFlusher()
+
+
+def _flush_at_exit() -> None:
+    if global_flusher.armed:
+        global_flusher.flush()
+
+
+atexit.register(_flush_at_exit)

@@ -145,6 +145,10 @@ class MetricsRegistry:
         # backends without memory_stats — obs/memory.py)
         from .memory import global_watermarks
         global_watermarks.enable()
+        # and the XLA program introspector (compile time + cost analysis
+        # per program boundary — obs/xla.py)
+        from .xla import global_xla
+        global_xla.enable()
 
     def disable(self) -> None:
         self.enabled = False

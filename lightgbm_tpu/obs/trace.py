@@ -53,12 +53,14 @@ _NULL_SPAN = _NullSpan()
 
 class _SpanFrame:
     """One live span (context manager); exists only while enabled."""
-    __slots__ = ("tracer", "name", "block", "t0", "child_ns")
+    __slots__ = ("tracer", "name", "block", "args", "t0", "child_ns")
 
-    def __init__(self, tracer: "Tracer", name: str, block) -> None:
+    def __init__(self, tracer: "Tracer", name: str, block,
+                 args=None) -> None:
         self.tracer = tracer
         self.name = name
         self.block = block
+        self.args = args
         self.child_ns = 0
 
     def __enter__(self) -> "_SpanFrame":
@@ -90,7 +92,7 @@ class _SpanFrame:
         if stack:
             stack[-1].child_ns += dur
         tracer._record(self.name, self.t0, dur, dur - self.child_ns,
-                       len(stack))
+                       len(stack), self.args)
         return False
 
 
@@ -110,7 +112,8 @@ class Tracer:
         self._tls = threading.local()  # per-thread span stack
         self._lock = threading.Lock()  # guards _events/_agg/sinks
         self._dropped_events = 0
-        # completed spans: (name, start_ns, dur_ns, self_ns, depth, tid)
+        # completed spans:
+        # (name, start_ns, dur_ns, self_ns, depth, tid, args-or-None)
         self._events: List[tuple] = []
         self._thread_names: Dict[int, str] = {}  # tid -> thread name
         self._agg: Dict[str, List[float]] = {}  # name -> [total, self, count]
@@ -163,23 +166,41 @@ class Tracer:
             self._sinks.append(sink)
 
     # ------------------------------------------------------------------
-    def span(self, name: str, block: Optional[Any] = None):
+    def span(self, name: str, block: Optional[Any] = None,
+             args: Optional[Dict[str, Any]] = None):
         """Time a nested phase. Disabled mode returns a shared no-op
-        context manager (no allocation)."""
+        context manager (no allocation). `args` (a small dict) rides
+        into the Chrome event's ``args`` — the request-tracing link
+        fields (trace_id, batch_id, ...) travel this way."""
         if not self.enabled:
             return _NULL_SPAN
-        return _SpanFrame(self, name, block)
+        return _SpanFrame(self, name, block, args)
+
+    def add_complete_span(self, name: str, start_ns: int, dur_ns: int,
+                          args: Optional[Dict[str, Any]] = None,
+                          tid: Optional[int] = None) -> None:
+        """Record an already-timed span retroactively (the serve path
+        emits per-request and per-batch attribution spans after the
+        fact, once queue-wait and device time are known). Does not
+        touch the live span stack and does not fire sinks — these are
+        attribution records, not training phases."""
+        if not self.enabled:
+            return
+        self._record(name, int(start_ns), int(dur_ns), int(dur_ns), 0,
+                     args, tid=tid, fire_sinks=False)
 
     def _record(self, name: str, start_ns: int, dur_ns: int, self_ns: int,
-                depth: int) -> None:
-        tid = threading.get_ident()
+                depth: int, args: Optional[Dict[str, Any]] = None,
+                tid: Optional[int] = None, fire_sinks: bool = True) -> None:
+        if tid is None:
+            tid = threading.get_ident()
         with self._lock:
             if tid not in self._thread_names:
                 # for the thread_name metadata events in chrome_events
                 self._thread_names[tid] = threading.current_thread().name
             if len(self._events) < self.MAX_EVENTS:
                 self._events.append((name, start_ns, dur_ns, self_ns,
-                                     depth, tid))
+                                     depth, tid, args))
             else:
                 self._dropped_events += 1
             agg = self._agg.get(name)
@@ -188,8 +209,9 @@ class Tracer:
             agg[0] += dur_ns * 1e-9
             agg[1] += self_ns * 1e-9
             agg[2] += 1
-        for sink in self._sinks:
-            sink(name, dur_ns * 1e-9, self_ns * 1e-9)
+        if fire_sinks:
+            for sink in self._sinks:
+                sink(name, dur_ns * 1e-9, self_ns * 1e-9)
 
     # ------------------------------------------------------------------
     def summary(self) -> Dict[str, Dict[str, float]]:
@@ -255,8 +277,12 @@ class Tracer:
             names = dict(self._thread_names)
         events = self._metadata_events(pid, {e[5] for e in snapshot}
                                        | set(names), names)
-        for name, start_ns, dur_ns, self_ns, depth, tid in sorted(
+        for name, start_ns, dur_ns, self_ns, depth, tid, extra in sorted(
                 snapshot, key=lambda e: e[1]):
+            args: Dict[str, Any] = {"self_us": self_ns / 1000.0,
+                                    "depth": depth}
+            if extra:
+                args.update(extra)
             events.append({
                 "name": name,
                 "ph": "X",
@@ -264,7 +290,7 @@ class Tracer:
                 "dur": dur_ns / 1000.0,
                 "pid": pid,
                 "tid": tid,
-                "args": {"self_us": self_ns / 1000.0, "depth": depth},
+                "args": args,
             })
         return events
 

@@ -501,7 +501,8 @@ def _traversal_program(mesh, k: int, max_depth: int, has_cat: bool = True):
             in_specs=tuple([rep] * len(_ARRAY_FIELDS))
             + (P(mesh_lib.DATA_AXIS, None),),
             out_specs=P(mesh_lib.DATA_AXIS, None))
-    return jax.jit(global_metrics.wrap_traced(PREDICT_TRACE_TAG, run))
+    from ..obs import xla as obs_xla
+    return obs_xla.instrumented_jit(PREDICT_TRACE_TAG, run, phase="predict")
 
 
 def _row_bucket(rows: int, chunk: int, mesh) -> int:

@@ -30,12 +30,18 @@ no locks are needed.
 from __future__ import annotations
 
 import asyncio
+import itertools
 import time
 from typing import Awaitable, Callable, List, Tuple
 
 import numpy as np
 
 from ..obs.metrics import global_metrics
+from ..obs.trace import global_tracer
+
+# process-wide batch ids: the link key between a coalesced batch's
+# device span and the request spans it carried (request tracing)
+_batch_ids = itertools.count(1)
 
 
 class MicroBatcher:
@@ -46,7 +52,7 @@ class MicroBatcher:
         self.max_batch_rows = max(int(max_batch_rows), 1)
         self.max_wait_s = max(float(max_wait_s), 0.0)
         self._executor = executor
-        self._pending: List[Tuple[np.ndarray, asyncio.Future]] = []
+        self._pending: List[Tuple[np.ndarray, asyncio.Future, object]] = []
         self._pending_rows = 0
         self._timer = None
         self._oldest_t0 = 0.0
@@ -56,10 +62,12 @@ class MicroBatcher:
         return self._pending_rows
 
     # ------------------------------------------------------------------
-    def submit(self, x: np.ndarray) -> Awaitable[np.ndarray]:
+    def submit(self, x: np.ndarray, trace=None) -> Awaitable[np.ndarray]:
         """Queue `x` ([B, F]) for the next coalesced dispatch; resolves
         to the raw [B, K] scores for exactly these rows. Must be called
-        on the event-loop thread."""
+        on the event-loop thread. `trace` (a server ``_RequestTrace``,
+        present only while the tracer runs) receives this request's
+        queue-wait/device-time attribution and batch link at flush."""
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         if self._pending and \
@@ -71,7 +79,7 @@ class MicroBatcher:
             self._flush(loop)
         if not self._pending:
             self._oldest_t0 = time.perf_counter()
-        self._pending.append((x, fut))
+        self._pending.append((x, fut, trace))
         self._pending_rows += x.shape[0]
         if self._pending_rows >= self.max_batch_rows:
             self._flush(loop)
@@ -96,7 +104,7 @@ class MicroBatcher:
         self._pending = []
         self._pending_rows = 0
 
-        xs = [x for x, _ in batch]
+        xs = [x for x, _, _ in batch]
         xcat = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
         global_metrics.inc_counter("serve/batches")
         global_metrics.inc_counter("serve/batched_rows", xcat.shape[0])
@@ -106,18 +114,45 @@ class MicroBatcher:
         global_metrics.note_latency(
             "serve/batch_wait", time.perf_counter() - self._oldest_t0)
 
-        task = loop.run_in_executor(self._executor, self._predict_fn, xcat)
+        traces = [tr for _, _, tr in batch if tr is not None]
+        if traces:
+            # queue wait ends now; the device span is timed on the
+            # executor thread and linked back by batch_id
+            batch_id = next(_batch_ids)
+            flush_ns = time.perf_counter_ns()
+            for tr in traces:
+                tr.queue_ns = flush_ns - tr.t0_ns
+                tr.batch_id = batch_id
+            rows = int(xcat.shape[0])
+            predict_fn = self._predict_fn
+
+            def timed_predict(xb=xcat):
+                t_dev = time.perf_counter_ns()
+                out = predict_fn(xb)
+                dev_ns = time.perf_counter_ns() - t_dev
+                for tr in traces:
+                    tr.device_ns = dev_ns
+                global_tracer.add_complete_span(
+                    "serve/batch", t_dev, dev_ns,
+                    args={"batch_id": batch_id, "rows": rows,
+                          "trace_ids": [tr.trace_id for tr in traces]})
+                return out
+
+            task = loop.run_in_executor(self._executor, timed_predict)
+        else:
+            task = loop.run_in_executor(self._executor, self._predict_fn,
+                                        xcat)
 
         def scatter(done: asyncio.Future) -> None:
             try:
                 out = done.result()
             except BaseException as exc:  # propagate to every waiter
-                for _, fut in batch:
+                for _, fut, _ in batch:
                     if not fut.done():
                         fut.set_exception(exc)
                 return
             lo = 0
-            for x, fut in batch:
+            for x, fut, _ in batch:
                 hi = lo + x.shape[0]
                 if not fut.done():  # waiter may have been cancelled
                     fut.set_result(out[lo:hi])

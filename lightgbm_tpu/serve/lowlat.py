@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from ..obs.metrics import global_metrics
+from ..obs.xla import global_xla
 from ..ops.predict import (_ARRAY_FIELDS, PackedEnsemble, _next_pow2,
                            pack_ensemble, predict_raw_multiclass)
 
@@ -101,8 +102,16 @@ class LowLatencyPredictor:
                       for a in self._arrs]
             shapes.append(jax.ShapeDtypeStruct(
                 (rows_bucket, num_features), jnp.float32))
+            t0 = time.perf_counter()
             prog = jax.jit(global_metrics.wrap_traced(SERVE_LOWLAT_TAG, run)
                            ).lower(*shapes).compile()
+            if global_xla.enabled:
+                # this path IS the lower/compile boundary — record the
+                # executable's cost facts straight into the introspector
+                global_xla.note_compile(
+                    SERVE_LOWLAT_TAG, "serve",
+                    f"{rows_bucket}x{num_features}",
+                    time.perf_counter() - t0, prog)
             self._compiled[key] = prog
         return prog
 

@@ -18,6 +18,19 @@ Per-request latency lands in the always-on ``obs.metrics`` reservoirs
 counts in the ``serve/*`` counters, and the registry's pack budget is
 re-enforced after every request.
 
+With the span tracer running (``LGBM_TPU_TRACE``), every request gets a
+process-unique **trace ID** and lands in the Chrome trace as a
+``serve/request`` span carrying ``args.trace_id`` plus its queue-wait /
+device-time split; a coalesced batch's device work appears as one
+``serve/batch`` span whose ``args.trace_ids`` lists exactly the
+requests it carried (link fields validated by ``tools/check_trace.py``).
+Tracer off ⇒ one attribute check per request.
+
+``/metrics``, ``/healthz`` and ``/readyz`` are served by
+``start_metrics_endpoint()`` (obs/export.py): readiness is false while
+any ``warm()`` is in flight or no model is registered, so a rollout
+can gate traffic on the warmed program set.
+
 ``serve_file`` is the thin driver behind ``python -m lightgbm_tpu
 serve``: it replays a data file through the server as concurrent
 requests and emits one summary JSON line.
@@ -26,6 +39,8 @@ requests and emits one summary JSON line.
 from __future__ import annotations
 
 import asyncio
+import itertools
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence
@@ -33,8 +48,27 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..obs.metrics import global_metrics
+from ..obs.trace import global_tracer
 from .batcher import MicroBatcher
 from .registry import ModelRegistry, ServedModel
+
+_trace_ids = itertools.count(1)
+
+
+class _RequestTrace:
+    """Per-request attribution carried through the batcher/lowlat split
+    while the tracer runs: trace id, queue-wait and device-time in ns,
+    and the coalesced batch link."""
+    __slots__ = ("trace_id", "t0_ns", "queue_ns", "device_ns", "batch_id",
+                 "path")
+
+    def __init__(self) -> None:
+        self.trace_id = f"{os.getpid():x}-{next(_trace_ids)}"
+        self.t0_ns = time.perf_counter_ns()
+        self.queue_ns = 0
+        self.device_ns = 0
+        self.batch_id = None
+        self.path = ""
 
 # the default request-size cycle for file replay (serve_request_rows=0):
 # mostly low-latency-path sizes with periodic medium batches — the
@@ -57,6 +91,8 @@ class ModelServer:
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="lgbm-serve")
         self._batchers: Dict[str, MicroBatcher] = {}
+        self._warming = 0  # warm() calls in flight (readiness gate)
+        self._metrics_endpoint = None
 
     # ------------------------------------------------------------------
     def _batcher(self, entry: ServedModel) -> MicroBatcher:
@@ -86,16 +122,35 @@ class ModelServer:
                 f"request has {x.shape[1]} features but model "
                 f"'{name}' expects {need}")
         loop = asyncio.get_running_loop()
+        # request-scoped tracing: one attribute check when the tracer is
+        # off; otherwise the request gets a trace id and its queue/device
+        # attribution is collected through whichever path serves it
+        rt = _RequestTrace() if global_tracer.enabled else None
         # a server-level threshold can only lower the routing cut below
         # the per-entry AOT limit, never push requests past it
         lowlat_cap = min(self.lowlat_max_rows, entry.lowlat_max_rows)
         if x.shape[0] <= lowlat_cap and entry.supports_lowlat:
             global_metrics.inc_counter("serve/lowlat_requests")
-            raw = await loop.run_in_executor(
-                self._executor, entry.lowlat_predict, x)
+            if rt is None:
+                raw = await loop.run_in_executor(
+                    self._executor, entry.lowlat_predict, x)
+            else:
+                rt.path = "lowlat"
+
+                def timed_lowlat(x=x, entry=entry, rt=rt):
+                    t_dev = time.perf_counter_ns()
+                    rt.queue_ns = t_dev - rt.t0_ns  # executor queue wait
+                    out = entry.lowlat_predict(x)
+                    rt.device_ns = time.perf_counter_ns() - t_dev
+                    return out
+
+                raw = await loop.run_in_executor(self._executor,
+                                                 timed_lowlat)
         else:
             global_metrics.inc_counter("serve/batched_requests")
-            raw = await self._batcher(entry).submit(x)
+            if rt is not None:
+                rt.path = "batched"
+            raw = await self._batcher(entry).submit(x, trace=rt)
         out = raw[:, 0] if raw.shape[1] == 1 else raw
         if not raw_score:
             from ..model_io import transform_raw
@@ -104,6 +159,16 @@ class ModelServer:
         global_metrics.inc_counter("serve/rows", x.shape[0])
         global_metrics.note_latency("serve/request",
                                     time.perf_counter() - t0)
+        if rt is not None:
+            args = {"trace_id": rt.trace_id, "path": rt.path,
+                    "rows": int(x.shape[0]),
+                    "queue_wait_us": rt.queue_ns / 1e3,
+                    "device_us": rt.device_ns / 1e3}
+            if rt.batch_id is not None:
+                args["batch_id"] = rt.batch_id
+            global_tracer.add_complete_span(
+                "serve/request", rt.t0_ns,
+                time.perf_counter_ns() - rt.t0_ns, args=args)
         self.registry.evict_to_budget()
         return out
 
@@ -113,14 +178,53 @@ class ModelServer:
         latency bucket ladder plus the engine's power-of-two batch
         buckets up to max_batch_rows. After this, steady-state traffic
         of any request mix runs with ZERO recompiles (asserted by
-        tools/check_serve.py through the obs recompile counters)."""
-        entry = self.registry.get(name)
-        if entry.supports_lowlat:
-            entry.lowlat.warm(num_features)
-        b = 16  # engine buckets floor at 16 rows (ops/predict._row_bucket)
-        while b < 2 * self.max_batch_rows:
-            entry.predict_raw(np.zeros((b, num_features)))
-            b <<= 1
+        tools/check_serve.py through the obs recompile counters).
+
+        While a warm() is in flight the server reports NOT ready
+        (``/readyz`` 503) — a rollout that gates traffic on readiness
+        never lands requests on cold programs."""
+        self._warming += 1
+        try:
+            entry = self.registry.get(name)
+            if entry.supports_lowlat:
+                entry.lowlat.warm(num_features)
+            # engine buckets floor at 16 rows (ops/predict._row_bucket)
+            b = 16
+            while b < 2 * self.max_batch_rows:
+                entry.predict_raw(np.zeros((b, num_features)))
+                b <<= 1
+        finally:
+            self._warming -= 1
+
+    @property
+    def ready(self) -> bool:
+        """Readiness: at least one model registered and no warm() in
+        flight. Liveness (``/healthz``) is just the listener being up."""
+        return self._warming == 0 and len(self.registry) > 0
+
+    def start_metrics_endpoint(self, port: int = 0,
+                               host: Optional[str] = None):
+        """Serve ``/metrics`` (Prometheus text format over the obs
+        registries + this server's pack/registry gauges), ``/healthz``
+        and ``/readyz`` on a daemon thread. port=0 binds an ephemeral
+        port (read it back from ``.port``). `host` defaults to the
+        ``LGBM_TPU_METRICS_HOST`` env var or loopback — external
+        readiness probes / scrapers need ``0.0.0.0`` (opt-in: the
+        document exposes host internals). Returns the endpoint."""
+        from ..obs.export import MetricsHTTPEndpoint, render_openmetrics
+
+        def render() -> str:
+            return render_openmetrics(extra_gauges={
+                "lgbmtpu_serve_pack_bytes": self.registry.pack_bytes(),
+                "lgbmtpu_serve_models": len(self.registry),
+            })
+
+        if host is None:
+            host = os.environ.get("LGBM_TPU_METRICS_HOST", "") \
+                or "127.0.0.1"
+        self._metrics_endpoint = MetricsHTTPEndpoint(
+            render, ready_fn=lambda: self.ready, port=port, host=host)
+        return self._metrics_endpoint
 
     def stats(self) -> Dict:
         """Serving snapshot: request latency quantiles + counters."""
@@ -140,6 +244,9 @@ class ModelServer:
         for b in self._batchers.values():
             b.flush()
         self._executor.shutdown(wait=True)
+        if self._metrics_endpoint is not None:
+            self._metrics_endpoint.close()
+            self._metrics_endpoint = None
 
 
 # ----------------------------------------------------------------------
@@ -209,6 +316,10 @@ def serve_file(input_model: str, data_path: str, output_result: str,
     server = ModelServer(registry,
                          max_batch_rows=cfg.serve_max_batch_rows,
                          max_wait_ms=cfg.serve_max_wait_ms)
+    metrics_port = None
+    if int(cfg.serve_metrics_port) >= 0:
+        metrics_port = server.start_metrics_endpoint(
+            int(cfg.serve_metrics_port)).port
     sizes = request_sizes(data.shape[0], cfg.serve_request_rows)
 
     async def run() -> List[np.ndarray]:
@@ -228,4 +339,6 @@ def serve_file(input_model: str, data_path: str, output_result: str,
     stats.update(requests=len(outs), rows=int(data.shape[0]),
                  seconds=round(elapsed, 4),
                  rows_per_sec=round(data.shape[0] / max(elapsed, 1e-9), 1))
+    if metrics_port is not None:
+        stats["metrics_port"] = metrics_port
     return stats

@@ -489,3 +489,115 @@ class TestRegisterLogger:
             log.register_logger(logger, info_method_name="my_info",
                                 warning_method_name="my_warning",
                                 debug_method_name="nope")
+
+
+# ---------------------------------------------------------------------------
+# disabled-path cost of the introspection layer (exporter, xla, request
+# tracing): telemetry off must mean guard checks only — nothing routed,
+# nothing recorded, nothing allocated
+class TestDisabledIntrospectionLayer:
+    def test_xla_introspector_disabled_is_passthrough(self):
+        from lightgbm_tpu.obs.xla import XlaIntrospector, instrumented_jit
+        reg = XlaIntrospector()
+        assert not reg.enabled  # env-gated, off under the test env
+        compiles = []
+        g = instrumented_jit("off/prog", lambda x: x * 3, registry=reg)
+        # break AOT entry points: if the disabled path ever touched
+        # them the call would explode
+        g.__wrapped_jit__.lower = lambda *a, **k: compiles.append(1)
+        out = g(np.ones(4, np.float32))
+        np.testing.assert_array_equal(np.asarray(out), [3.0] * 4)
+        assert reg.n_programs == 0 and compiles == []
+        assert reg.summary()["compile_s_total"] == 0.0
+
+    def test_flusher_unarmed_is_attribute_check(self, monkeypatch,
+                                                tmp_path):
+        from lightgbm_tpu.obs.export import MetricsTextfileFlusher
+        monkeypatch.delenv("LGBM_TPU_METRICS_FILE", raising=False)
+        fl = MetricsTextfileFlusher()
+        assert not fl.armed
+        assert fl.maybe_flush() is False
+        assert list(tmp_path.iterdir()) == []
+
+    def test_span_args_disabled_returns_shared_noop(self):
+        tr = Tracer()
+        assert tr.span("x", args={"trace_id": "t"}) is _NULL_SPAN
+        tr.add_complete_span("late", 0, 100, args={"trace_id": "t"})
+        assert tr._events == [] and tr.summary() == {}
+
+    def test_enabled_span_args_reach_chrome_events(self):
+        tr = Tracer()
+        tr.enable()
+        with tr.span("phase", args={"k": "v"}):
+            pass
+        tr.add_complete_span("late", 10, 100, args={"trace_id": "t-1"})
+        by_name = {e["name"]: e for e in tr.chrome_events()
+                   if e["ph"] == "X"}
+        assert by_name["phase"]["args"]["k"] == "v"
+        assert by_name["phase"]["args"]["depth"] == 0  # std args kept
+        assert by_name["late"]["args"]["trace_id"] == "t-1"
+        assert by_name["late"]["dur"] == pytest.approx(0.1)  # us
+
+    def test_metrics_enable_arms_xla_and_restore_disarms(self):
+        from lightgbm_tpu.obs.trace import global_tracer
+        from lightgbm_tpu.obs.xla import global_xla
+        assert not global_metrics.enabled and not global_xla.enabled
+        tracer_was = global_tracer.enabled
+        _train_with_telemetry(2)
+        # the scoped enable armed the introspector for the run only
+        assert not global_xla.enabled
+        assert not global_metrics.enabled
+        assert global_tracer.enabled == tracer_was
+
+
+# ---------------------------------------------------------------------------
+# structured JSON log mode (LGBM_TPU_LOG_JSON)
+class TestJsonLogMode:
+    def test_json_records_carry_host_labels(self, capsys):
+        import socket
+        log.set_json_mode(True)
+        log.set_verbosity(1)  # earlier trainings lower the threshold
+        try:
+            log.info("hello world")
+            log.warning("watch out")
+        finally:
+            log.set_json_mode(False)
+        lines = [ln for ln in capsys.readouterr().out.splitlines() if ln]
+        recs = [json.loads(ln) for ln in lines]
+        assert [r["msg"] for r in recs] == ["hello world", "watch out"]
+        assert [r["level"] for r in recs] == ["Info", "Warning"]
+        for r in recs:
+            assert r["hostname"] == socket.gethostname()
+            assert r["pid"] == str(os.getpid())
+            assert r["ts"] > 0
+
+    def test_env_var_arms_json_mode(self, monkeypatch, capsys):
+        import importlib
+        monkeypatch.setenv("LGBM_TPU_LOG_JSON", "1")
+        importlib.reload(log)
+        try:
+            log.set_verbosity(1)
+            log.info("from env")
+            rec = json.loads(capsys.readouterr().out.strip())
+            assert rec["msg"] == "from env"
+        finally:
+            monkeypatch.delenv("LGBM_TPU_LOG_JSON")
+            importlib.reload(log)
+        assert not log._json_mode
+
+    def test_registered_logger_bypasses_json_wrapping(self, capsys):
+        logger = _CollectingLogger()
+        log.set_json_mode(True)
+        log.set_verbosity(1)
+        try:
+            log.register_logger(logger, info_method_name="my_info",
+                                warning_method_name="my_warning")
+            log.info("plain")
+            assert ("info", "plain") in logger.lines  # raw msg, not JSON
+            assert capsys.readouterr().out == ""
+        finally:
+            log.set_json_mode(False)
+            log._logger = None
+            log._info_method = "info"
+            log._warning_method = "warning"
+            log._debug_method = None

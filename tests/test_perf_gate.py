@@ -134,3 +134,53 @@ def test_gate_parses_driver_wrapper_shape():
                 '"value": 1.5, "vs_baseline": 0.39, "unit": "iters/sec"}\n'})
     assert rec is not None and rec["vs_baseline"] == 0.39
     assert check_perf_gate._extract_metric_record({"tail": "junk"}) is None
+
+
+def test_xla_cross_check_runs_and_agrees(capsys):
+    """Check 5 (ISSUE 9): the compiled packed+int8 wave kernel's
+    argument bytes agree with the analytic traffic model per-pass
+    within the declared band, and the memory model's operand/slab
+    components cover the executable's buffers — on the CPU backend the
+    check must RUN (not skip)."""
+    with open(check_perf_gate.FLOOR_PATH) as fh:
+        floor = json.load(fh)
+    assert floor["xla"]["arg_bytes_band"] >= 1.0
+    failures = []
+    check_perf_gate.check_xla_cost_model(floor, failures)
+    out = capsys.readouterr().out
+    assert failures == []
+    assert "xla vs traffic model" in out
+    assert "xla vs memory model" in out
+    assert "skipped" not in out
+
+
+def test_xla_cross_check_flags_model_divergence(capsys):
+    """A traffic model that diverged from what XLA streams must fail
+    the band: simulate by shrinking the declared band to ~0."""
+    with open(check_perf_gate.FLOOR_PATH) as fh:
+        floor = json.load(fh)
+    floor["xla"] = dict(floor["xla"], arg_bytes_band=1.0000001,
+                        min_bytes_accessed_ratio=1e9)
+    failures = []
+    check_perf_gate.check_xla_cost_model(floor, failures)
+    # the tight band trips at least the bytes-accessed ratio check
+    assert any("xla cross-check" in f for f in failures)
+
+
+def test_xla_cross_check_skips_gracefully(capsys, monkeypatch):
+    """No cost analysis on the backend => skip, never fail (the TPU
+    relay path can't be probed from CI)."""
+    import lightgbm_tpu.obs.xla as obs_xla
+    monkeypatch.setattr(obs_xla, "aot_cost_summary",
+                        lambda *a, **k: None)
+    with open(check_perf_gate.FLOOR_PATH) as fh:
+        floor = json.load(fh)
+    failures = []
+    check_perf_gate.check_xla_cost_model(floor, failures)
+    assert failures == []
+    assert "skipped" in capsys.readouterr().out
+
+    # a missing floor section also skips
+    failures = []
+    check_perf_gate.check_xla_cost_model({}, failures)
+    assert failures == []

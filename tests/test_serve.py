@@ -424,3 +424,181 @@ class TestCLIServe:
         got = np.loadtxt(out)
         want = bst.predict(np.loadtxt(data)[:, 1:])
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+# ----------------------------------------------------------------------
+class TestRequestTracing:
+    """Request-scoped trace spans (server + batcher + obs/trace): every
+    request in a replay appears as a linked `serve/request` span with
+    queue-wait / device-time attribution; coalesced batches list the
+    trace ids they carried; the whole trace passes check_trace."""
+
+    def setup_method(self):
+        from lightgbm_tpu.obs.trace import global_tracer
+        self._was_enabled = global_tracer.enabled
+        global_tracer.reset()
+
+    def teardown_method(self):
+        from lightgbm_tpu.obs.trace import global_tracer
+        if not self._was_enabled:
+            global_tracer.disable()
+        global_tracer.reset()
+
+    def _replayed_events(self, sizes, max_wait_ms=1.0):
+        from lightgbm_tpu.obs.trace import global_tracer
+        x, y = _data(n=max(sum(sizes), 200), nans=False)
+        _registry, server = _serve_setup(_model_str(x, y),
+                                         max_wait_ms=max_wait_ms)
+        server.warm("m", x.shape[1])
+        global_tracer.enable()
+
+        async def run():
+            try:
+                return await replay(server, "m", x, sizes, raw_score=True)
+            finally:
+                await server.close()
+
+        outs = asyncio.run(run())
+        return outs, global_tracer.chrome_events()
+
+    def test_every_request_appears_linked_and_attributed(self, tmp_path):
+        import json
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools"))
+        from check_trace import check_trace
+        from lightgbm_tpu.obs.trace import global_tracer
+
+        sizes = [1, 8, 200, 3, 300, 64, 150]
+        outs, events = self._replayed_events(sizes)
+        assert len(outs) == len(sizes)
+        reqs = [e for e in events if e["name"] == "serve/request"]
+        assert len(reqs) == len(sizes)
+        ids = set()
+        for ev in reqs:
+            args = ev["args"]
+            assert isinstance(args["trace_id"], str) and args["trace_id"]
+            ids.add(args["trace_id"])
+            assert args["queue_wait_us"] >= 0
+            assert args["device_us"] >= 0
+            assert args["path"] in ("lowlat", "batched")
+            if args["path"] == "batched":
+                assert "batch_id" in args
+        assert len(ids) == len(sizes)  # process-unique per request
+        # each batch span lists only request ids from this replay
+        batches = [e for e in events if e["name"] == "serve/batch"]
+        assert batches, "no coalesced batch span recorded"
+        batched_ids = {t for b in batches for t in b["args"]["trace_ids"]}
+        assert batched_ids <= ids
+        # requests that went through a batch point back at a real batch
+        batch_ids = {b["args"]["batch_id"] for b in batches}
+        for ev in reqs:
+            if "batch_id" in ev["args"]:
+                assert ev["args"]["batch_id"] in batch_ids
+        # and the exported file passes the validator's link checks
+        path = str(tmp_path / "serve_trace.json")
+        global_tracer.export_chrome(path)
+        ok, msg = check_trace(path)
+        assert ok, msg
+        assert "linked request span" in msg
+        with open(path) as fh:
+            n_req = sum(1 for e in json.load(fh)["traceEvents"]
+                        if e.get("name") == "serve/request")
+        assert n_req == len(sizes)
+
+    def test_check_trace_rejects_broken_links(self, tmp_path):
+        import json
+        import os
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools"))
+        from check_trace import check_trace
+        base = [{"name": "serve/request", "ph": "X", "ts": 1, "dur": 2,
+                 "pid": 1, "tid": 1,
+                 "args": {"trace_id": "a-1", "queue_wait_us": 1.0,
+                          "device_us": 2.0}}]
+        # batch referencing an unknown request id
+        doc = {"traceEvents": base + [
+            {"name": "serve/batch", "ph": "X", "ts": 3, "dur": 1,
+             "pid": 1, "tid": 2,
+             "args": {"batch_id": 9, "trace_ids": ["a-1", "GHOST"]}}]}
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps(doc))
+        ok, msg = check_trace(str(p))
+        assert not ok and "GHOST" in msg
+        # request missing its attribution args
+        doc2 = {"traceEvents": [
+            {"name": "serve/request", "ph": "X", "ts": 1, "dur": 2,
+             "pid": 1, "tid": 1, "args": {"trace_id": "a-1"}}]}
+        p.write_text(json.dumps(doc2))
+        ok, msg = check_trace(str(p))
+        assert not ok and "queue_wait_us" in msg
+        # request pointing at a batch that is not in the trace
+        doc3 = {"traceEvents": base}
+        doc3["traceEvents"][0]["args"]["batch_id"] = 77
+        p.write_text(json.dumps(doc3))
+        ok, msg = check_trace(str(p))
+        assert not ok and "77" in msg
+
+    def test_tracer_disabled_records_no_request_spans(self):
+        from lightgbm_tpu.obs.trace import global_tracer
+        x, y = _data(n=300, nans=False)
+        _registry, server = _serve_setup(_model_str(x, y))
+        assert not global_tracer.enabled
+
+        async def run():
+            try:
+                return await server.predict("m", x[:100], raw_score=True)
+            finally:
+                await server.close()
+
+        asyncio.run(run())
+        assert global_tracer._events == []
+
+
+# ----------------------------------------------------------------------
+class TestServerEndpoints:
+    def test_readiness_gates_on_registry_and_warming(self):
+        x, y = _data(n=200, nans=False)
+        registry = ModelRegistry()
+        server = ModelServer(registry)
+        assert not server.ready  # nothing registered
+        registry.load("m", model_str=_model_str(x, y, rounds=2))
+        assert server.ready
+        server._warming += 1  # a warm() in flight
+        assert not server.ready
+        server._warming -= 1
+        assert server.ready
+
+    def test_metrics_endpoint_serves_and_flips_readiness(self):
+        import urllib.error
+        import urllib.request
+
+        def get(port, path):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+                    return r.status, r.read().decode()
+            except urllib.error.HTTPError as exc:
+                return exc.code, exc.read().decode()
+
+        x, y = _data(n=300, nans=False)
+        _registry, server = _serve_setup(_model_str(x, y, rounds=2))
+        ep = server.start_metrics_endpoint(port=0)
+        try:
+            assert get(ep.port, "/healthz")[0] == 200
+            assert get(ep.port, "/readyz")[0] == 200
+            server._warming += 1
+            assert get(ep.port, "/readyz")[0] == 503
+            assert get(ep.port, "/healthz")[0] == 200  # liveness holds
+            server._warming -= 1
+            assert get(ep.port, "/readyz")[0] == 200
+            code, body = get(ep.port, "/metrics")
+            assert code == 200
+            assert "lgbmtpu_serve_pack_bytes" in body
+            assert "lgbmtpu_host_info" in body
+            assert get(ep.port, "/nope")[0] == 404
+        finally:
+            asyncio.run(server.close())
+        assert server._metrics_endpoint is None

@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""CI smoke for the serving observability endpoints (obs/export.py).
+
+Trains a small model, starts the in-process async server plus its
+``/metrics``+``/healthz``+``/readyz`` HTTP endpoint, then asserts:
+
+1. ``/healthz`` answers 200 from the moment the listener is up and
+   stays 200 throughout (liveness is the listener, nothing else);
+2. ``/readyz`` flips to 503 while ``warm()`` is in flight (readiness
+   gates traffic on the warmed program set) and back to 200 after;
+3. after serving mixed-size concurrent requests, ``/metrics`` is
+   valid Prometheus text format LINE BY LINE (every sample parses,
+   every family has a TYPE header, summary quantile labels present)
+   and exposes the request-latency quantiles, the serve/registry
+   counters, and the predict throughput series.
+
+Exit 0 = pass. Usage: python tools/check_metrics_endpoint.py
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Tuple
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+# one Prometheus text-format sample:  name{labels} value [timestamp]
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?P<labels>\{[^{}]*\})?"
+    r" (?P<value>[+-]?(?:[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?|[Nn]a[Nn]"
+    r"|[+-]?[Ii]nf))"
+    r"(?: [0-9]+)?$")
+_LABEL = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*="(?:[^"\\]|\\.)*"$')
+_TYPES = {"counter", "gauge", "summary", "histogram", "untyped"}
+
+
+def validate_exposition(text: str) -> Tuple[List[str], Dict[str, str]]:
+    """-> (errors, {family: type}) for a Prometheus text document.
+    Importable for tests; validates line by line."""
+    errors: List[str] = []
+    families: Dict[str, str] = {}
+    for i, line in enumerate(text.splitlines()):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) != 4 or parts[3] not in _TYPES:
+                errors.append(f"line {i}: malformed TYPE header: {line!r}")
+            else:
+                families[parts[2]] = parts[3]
+            continue
+        if line.startswith("#"):
+            continue  # HELP / comments
+        m = _SAMPLE.match(line)
+        if not m:
+            errors.append(f"line {i}: unparseable sample: {line!r}")
+            continue
+        labels = m.group("labels")
+        if labels:
+            for pair in _split_labels(labels[1:-1]):
+                if pair and not _LABEL.match(pair):
+                    errors.append(f"line {i}: bad label pair {pair!r}")
+        name = m.group("name")
+        base = re.sub(r"_(sum|count|bucket)$", "", name)
+        if name not in families and base not in families:
+            # every sample must belong to a TYPE-declared family
+            errors.append(f"line {i}: sample {name!r} has no TYPE header")
+    return errors, families
+
+
+def _split_labels(body: str) -> List[str]:
+    """Split `a="x",b="y,z"` on commas outside quotes."""
+    out, cur, in_q, esc = [], [], False, False
+    for ch in body:
+        if esc:
+            cur.append(ch)
+            esc = False
+        elif ch == "\\":
+            cur.append(ch)
+            esc = True
+        elif ch == '"':
+            cur.append(ch)
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        out.append("".join(cur))
+    return out
+
+
+def _get(port: int, path: str) -> Tuple[int, str]:
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import ModelRegistry, ModelServer
+    from lightgbm_tpu.serve.server import replay
+
+    rng = np.random.RandomState(0)
+    n, f = 600, 8
+    x = rng.randn(n, f)
+    y = ((x[:, 2] + x[:, 4]) > 0.3).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(x, label=y, params=params),
+                    num_boost_round=5)
+
+    registry = ModelRegistry()
+    registry.load("smoke", booster=bst)
+    server = ModelServer(registry, max_batch_rows=1024, max_wait_ms=1.0)
+    endpoint = server.start_metrics_endpoint(port=0)
+    failures = 0
+
+    code, _ = _get(endpoint.port, "/healthz")
+    if code != 200:
+        print(f"FAIL: /healthz returned {code} before warm")
+        failures += 1
+
+    # readiness must flip 503 while warm() is in flight. warm() on a
+    # tiny CPU model can be near-instant, so inject a deterministic
+    # delay into the lowlat ladder it compiles through.
+    entry = registry.get("smoke")
+    lowlat = entry.lowlat
+    orig_warm = lowlat.warm
+
+    def slow_warm(num_features: int) -> int:
+        time.sleep(0.3)
+        return orig_warm(num_features)
+
+    lowlat.warm = slow_warm
+    warm_thread = threading.Thread(target=server.warm, args=("smoke", f))
+    warm_thread.start()
+    saw_unready = False
+    deadline = time.time() + 10
+    while warm_thread.is_alive() and time.time() < deadline:
+        code, _ = _get(endpoint.port, "/readyz")
+        if code == 503:
+            saw_unready = True
+        code_h, _ = _get(endpoint.port, "/healthz")
+        if code_h != 200:
+            print(f"FAIL: /healthz returned {code_h} during warm")
+            failures += 1
+            break
+        time.sleep(0.01)
+    warm_thread.join()
+    lowlat.warm = orig_warm
+    if not saw_unready:
+        print("FAIL: /readyz never returned 503 during warm()")
+        failures += 1
+    code, _ = _get(endpoint.port, "/readyz")
+    if code != 200:
+        print(f"FAIL: /readyz returned {code} after warm completed")
+        failures += 1
+
+    # drive mixed traffic so the latency reservoirs and counters fill
+    sizes = [1, 8, 130, 3, 64, 300, 16, 2]
+    xt = rng.randn(sum(sizes), f)
+
+    async def run():
+        return await replay(server, "smoke", xt, sizes, raw_score=True)
+
+    asyncio.run(run())
+
+    code, body = _get(endpoint.port, "/metrics")
+    if code != 200:
+        print(f"FAIL: /metrics returned {code}")
+        failures += 1
+        body = ""
+    errors, families = validate_exposition(body)
+    for e in errors[:10]:
+        print(f"FAIL: {e}")
+    failures += len(errors)
+
+    required = [
+        'lgbmtpu_latency_seconds{name="serve/request",quantile="0.99"}',
+        'lgbmtpu_latency_seconds_count{name="serve/request"}',
+        "lgbmtpu_serve_requests_total",
+        "lgbmtpu_serve_registry_hit_total",
+        "lgbmtpu_predict_rows_total",
+        "lgbmtpu_host_info",
+        "lgbmtpu_serve_pack_bytes",
+    ]
+    for needle in required:
+        if needle not in body:
+            print(f"FAIL: /metrics is missing {needle!r}")
+            failures += 1
+
+    asyncio.run(server.close())
+    if failures:
+        print(f"check_metrics_endpoint: {failures} failure(s)")
+        return 1
+    print(f"check_metrics_endpoint: OK ({len(body.splitlines())} lines, "
+          f"{len(families)} metric families, readiness flipped around "
+          f"warm())")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

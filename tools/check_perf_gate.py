@@ -2,7 +2,7 @@
 """Perf-regression gate (ROADMAP item 4: convert "should be fast" into
 driver-visible proof).
 
-Four checks, all against the recorded floor in tools/perf_floor.json:
+Five checks, all against the recorded floor in tools/perf_floor.json:
 
 1. **Histogram traffic model** — recomputes the static per-iteration
    HBM byte model (learner.hist_traffic_model) for the recorded
@@ -36,6 +36,19 @@ Four checks, all against the recorded floor in tools/perf_floor.json:
    platform, a phase above the absolute-noise floor may not exceed its
    best (lowest) recorded time by the configured fraction. No recorded
    phase summaries => the check reports itself skipped.
+
+5. **XLA cross-check of the analytic models** — compiles the actual
+   packed+quantized wave histogram kernel for the recorded fixture
+   shape and holds the analytic traffic/memory models to what XLA's
+   OWN analyses say about the executable (obs/xla.py): the compiled
+   program's argument bytes must agree with the traffic model's
+   per-pass operand bytes within the declared band (so
+   `hist_bytes_per_iter` = passes x per-pass is cross-validated
+   end-to-end), XLA's `bytes accessed` must not fall BELOW the model
+   (a model that claims more streaming than the program can touch is
+   broken), and the memory model's operand/slab components must cover
+   the executable's argument/output buffers. Independent, silicon-free
+   proof; skips gracefully where the backend exposes no cost analysis.
 
 Exit 0 = gate passed; exit 1 = regression, with one line per failure.
 Wired into the quick verification tier via tests/test_perf_gate.py.
@@ -209,6 +222,113 @@ def check_phase_trajectory(floor, failures, lines):
               f"against floor ({tag})")
 
 
+def check_xla_cost_model(floor, failures):
+    """XLA-vs-analytic-model band (check 5). Compiles the packed+int8
+    wave histogram kernel (the exact program the quantized fixture
+    trains through on every backend) at the recorded fixture shape and
+    cross-validates both PR-4/5 models against the executable's own
+    cost/memory analyses. Returns silently-skipped when the backend
+    exposes neither analysis."""
+    cfg = floor.get("xla")
+    if not cfg:
+        print("# no xla floor recorded; xla cross-check skipped")
+        return
+    fx = cfg["fixture"]
+    n, f = int(fx["num_data"]), int(fx["storage_features"])
+    b, s = int(fx["max_bins"]), int(fx["num_slots"])
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from lightgbm_tpu.learner import hist_traffic_model
+        from lightgbm_tpu.obs.memory import train_memory_model
+        from lightgbm_tpu.obs.xla import aot_cost_summary
+        from lightgbm_tpu.ops import bin_pack as bp
+        from lightgbm_tpu.ops import pallas_histogram as ph
+
+        rng = np.random.RandomState(0)
+        host = bp.pack_bins_host(
+            rng.randint(0, b, size=(f, n)).astype(np.uint8), b)
+        packed = bp.to_device(host)
+        leaves, treedef = jax.tree_util.tree_flatten(packed)
+        ghT = jnp.asarray(rng.randint(-8, 8, size=(n, 3)), jnp.int8)
+        row_leaf = jnp.zeros(n, jnp.int32)
+        leaf_ids = jnp.arange(s, dtype=jnp.int32)
+
+        def run(leaves, ghT, row_leaf, leaf_ids):
+            pb = jax.tree_util.tree_unflatten(treedef, leaves)
+            return ph.hist_multi_int8_xla(pb, ghT, row_leaf, leaf_ids,
+                                          max_bins=b, num_slots=s)
+
+        cost = aot_cost_summary(run, leaves, ghT, row_leaf, leaf_ids)
+    except Exception as exc:
+        print(f"# xla cross-check skipped (introspection unavailable: "
+              f"{exc!r})")
+        return
+    if cost is None:
+        print("# xla cross-check skipped (no cost_analysis on this "
+              "backend)")
+        return
+
+    traffic = hist_traffic_model(
+        num_data=n, storage_features=f, max_bins=b,
+        num_leaves=fx.get("num_leaves", 255), wave_max=s,
+        gh_read_bytes=3, subtract=True)
+    per_pass = traffic["bytes_per_pass"]
+    band = float(cfg.get("arg_bytes_band", 1.25))
+
+    arg = cost.get("argument_bytes")
+    if arg:
+        ratio = arg / per_pass
+        if ratio > band or ratio < 1.0 / band:
+            failures.append(
+                f"xla cross-check: compiled wave-kernel argument bytes "
+                f"{arg / 1e6:.2f} MB vs traffic model per-pass "
+                f"{per_pass / 1e6:.2f} MB — ratio {ratio:.3f} outside "
+                f"the {1 / band:.2f}..{band:.2f} band "
+                f"(hist_bytes_per_iter no longer matches what XLA "
+                f"streams)")
+        else:
+            print(f"# xla vs traffic model: argument bytes ratio "
+                  f"{ratio:.3f} (band {1 / band:.2f}..{band:.2f}), "
+                  f"compile {cost['compile_s']:.2f}s")
+    ba = cost.get("bytes_accessed")
+    min_ratio = float(cfg.get("min_bytes_accessed_ratio", 1.0))
+    if ba is not None and ba < per_pass * min_ratio:
+        failures.append(
+            f"xla cross-check: XLA bytes-accessed {ba / 1e6:.2f} MB is "
+            f"BELOW the analytic per-pass model {per_pass / 1e6:.2f} MB "
+            f"x{min_ratio} — the traffic model overstates what the "
+            f"program touches")
+
+    # memory-model side: the model's operand components must cover the
+    # executable's resident argument buffers (within the same band) and
+    # the wave slab must cover the program's output
+    mem = train_memory_model(
+        num_data=n, num_features=f, max_bins=b,
+        num_leaves=fx.get("num_leaves", 255), wave_max=s,
+        pack_vpb=traffic["pack_vpb"], quantized=True)
+    comp = mem["components"]
+    operand_cover = comp["bins"] + comp["ght"] + comp["row_leaf"]
+    if arg and operand_cover * band < arg:
+        failures.append(
+            f"xla cross-check: memory-model operand components "
+            f"{operand_cover / 1e6:.2f} MB under-account the compiled "
+            f"kernel's argument buffers {arg / 1e6:.2f} MB "
+            f"(mem_peak_model_bytes misses a resident operand class)")
+    out_b = cost.get("output_bytes")
+    if out_b and comp["hist_wave"] * band < out_b:
+        failures.append(
+            f"xla cross-check: memory-model hist_wave slab "
+            f"{comp['hist_wave'] / 1e6:.2f} MB smaller than the "
+            f"compiled wave output {out_b / 1e6:.2f} MB")
+    elif arg and out_b:
+        print(f"# xla vs memory model: operands {operand_cover / 1e6:.2f}"
+              f" MB cover args {arg / 1e6:.2f} MB; wave slab "
+              f"{comp['hist_wave'] / 1e6:.3f} MB covers output "
+              f"{out_b / 1e6:.3f} MB")
+
+
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
@@ -259,6 +379,7 @@ def main(argv=None) -> int:
     failures = []
     actual = check_traffic_model(floor, failures)
     check_memory_model(floor, failures, candidate_rec)
+    check_xla_cost_model(floor, failures)
     check_bench_trajectory(floor, failures, lines, candidate_rec)
     check_phase_trajectory(floor, failures, lines)
     if failures:

@@ -20,7 +20,15 @@ Checks, in order:
      traces are unreadable pid/tid soup without them;
   6. per (pid, tid) track, ``ts`` is monotonically non-decreasing in
      file order (the exporter sorts by start time; a violation means a
-     corrupted or hand-edited trace).
+     corrupted or hand-edited trace);
+  7. request-scoped serve spans are LINKED: every ``serve/request``
+     span carries a non-empty ``args.trace_id`` plus numeric
+     ``args.queue_wait_us`` / ``args.device_us`` attribution, every
+     ``serve/batch`` span carries ``args.batch_id`` and a non-empty
+     ``args.trace_ids`` list, each listed trace_id resolves to a
+     request span in the same trace, and each batched request's
+     ``args.batch_id`` resolves to a batch span — so a coalesced batch
+     shows exactly which requests it carried.
 
 Usage:  python tools/check_trace.py TRACE.json
 Exit 0 when the trace is valid; 1 with a diagnostic otherwise — so a
@@ -61,6 +69,8 @@ def check_trace(path: str) -> Tuple[bool, str]:
     last_ts = {}  # (pid, tid) -> ts
     named_pids, named_tracks = set(), set()  # from metadata events
     n_complete = n_meta = 0
+    req_ids, req_batch_refs = set(), {}  # trace_id set; trace_id->batch_id
+    batch_ids, batch_links = set(), []   # batch_id set; (i, trace_ids)
     for i, ev in enumerate(events):
         if not isinstance(ev, dict):
             return False, f"event {i} is not an object"
@@ -84,6 +94,35 @@ def check_trace(path: str) -> Tuple[bool, str]:
         if ph != "X":
             continue  # metadata/counter events need no ts ordering
         n_complete += 1
+        if name == "serve/request":
+            args = ev.get("args")
+            if not isinstance(args, dict):
+                return False, f"serve/request event {i} has no args dict"
+            tid_ = args.get("trace_id")
+            if not isinstance(tid_, str) or not tid_:
+                return False, (f"serve/request event {i} lacks a "
+                               f"non-empty string args.trace_id")
+            for key in ("queue_wait_us", "device_us"):
+                v = args.get(key)
+                if not isinstance(v, (int, float)) or v < 0:
+                    return False, (f"serve/request event {i} "
+                                   f"(trace_id={tid_}) lacks numeric "
+                                   f"args.{key}")
+            req_ids.add(tid_)
+            if "batch_id" in args:
+                req_batch_refs[tid_] = args["batch_id"]
+        elif name == "serve/batch":
+            args = ev.get("args")
+            if not isinstance(args, dict) or "batch_id" not in args:
+                return False, (f"serve/batch event {i} lacks "
+                               f"args.batch_id")
+            ids = args.get("trace_ids")
+            if not isinstance(ids, list) or not ids or \
+                    not all(isinstance(t, str) and t for t in ids):
+                return False, (f"serve/batch event {i} lacks a non-empty "
+                               f"args.trace_ids string list")
+            batch_ids.add(args["batch_id"])
+            batch_links.append((i, ids))
         ts, dur = ev.get("ts"), ev.get("dur")
         if not isinstance(ts, (int, float)) or ts < 0:
             return False, f"event {i} ({name!r}) has invalid ts={ts!r}"
@@ -104,8 +143,20 @@ def check_trace(path: str) -> Tuple[bool, str]:
                 return False, (f"trace from lightgbm_tpu.obs.trace lacks a "
                                f"thread_name metadata event for track "
                                f"({pid}, {tid})")
+    # request<->batch linkage: every id a batch claims must be a request
+    # span in this trace, and every batched request's batch must exist
+    for i, ids in batch_links:
+        missing = [t for t in ids if t not in req_ids]
+        if missing:
+            return False, (f"serve/batch event {i} references trace_ids "
+                           f"{missing} with no matching serve/request span")
+    for tid_, bid in req_batch_refs.items():
+        if bid not in batch_ids:
+            return False, (f"serve/request {tid_} references batch_id "
+                           f"{bid!r} with no matching serve/batch span")
+    extra = (f", {len(req_ids)} linked request span(s)" if req_ids else "")
     return True, (f"ok: {n_complete} complete spans on {len(last_ts)} "
-                  f"track(s), {n_meta} metadata event(s)")
+                  f"track(s), {n_meta} metadata event(s){extra}")
 
 
 def main(argv: List[str]) -> int:
