@@ -271,11 +271,13 @@ def _measure():
         # and the XLA introspector (compile time + cost analysis per
         # program boundary)
         from lightgbm_tpu.obs import global_tracer
+        from lightgbm_tpu.obs.health import global_health
         from lightgbm_tpu.obs.memory import global_watermarks
         from lightgbm_tpu.obs.xla import global_xla
         global_tracer.enable()
         global_watermarks.enable()
         global_xla.enable()
+        global_health.enable()
 
     import jax
     # persistent compilation cache: a retried/repeated bench attempt (or
@@ -402,6 +404,15 @@ def _measure():
         if wm:
             result["mem_phase_watermarks"] = {
                 name: ph["delta_bytes"] for name, ph in wm.items()}
+        # training-health summary (obs/health.py): runtime-attributed
+        # collective calls/bytes per tag, the timed collective probe,
+        # straggler skew, drift/nonfinite counters — the comms-health
+        # side of the item-4 gate (tools/check_perf_gate.py health
+        # check reads these fields from the candidate JSON)
+        from lightgbm_tpu.obs.health import global_health
+        hs = global_health.summary()
+        if hs:
+            result["health"] = hs
     out_path = os.environ.get("BENCH_OUT")
     if out_path:  # orchestrated: parent prints the single contract line
         with open(out_path, "w") as fh:

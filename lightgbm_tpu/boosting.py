@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from .config import Config
 from .dataset import BinnedDataset
 from .learner import grow_tree, grow_tree_waved, replay_tree
+from .obs import health as obs_health
 from .obs import xla as obs_xla
 from .obs.export import global_flusher
 from .obs.metrics import global_metrics
@@ -54,6 +55,18 @@ def _multi_value(value):
 
 def _tree_record_to_host(record) -> Dict[str, np.ndarray]:
     return {k: np.asarray(v) for k, v in record._asdict().items()}
+
+
+def _nonfinite_counts(grad, hess, scores):
+    """Traced [3] int32 nonfinite-entry counts of (grad, hess, scores) —
+    the per-iteration NaN/Inf sentinel payload (obs/health.py). Pure
+    reductions: folding this into a fused program changes none of the
+    training math, so models are bit-identical with the sentinel on."""
+    def cnt(x):
+        if x is None:
+            return jnp.int32(0)
+        return jnp.sum(~jnp.isfinite(x)).astype(jnp.int32)
+    return jnp.stack([cnt(grad), cnt(hess), cnt(scores)])
 
 
 def _stack_class_records(recs):
@@ -219,6 +232,28 @@ class GBDT:
         self._sample_mask = jnp.ones(self.num_data, jnp.float32)
         self._grad_scale = None  # GOSS amplification, set per iter
 
+        # training-health sentinels (obs/health.py; tpu_health knob).
+        # Resolved BEFORE the grower build: the fused programs emit the
+        # sentinel outputs only when armed, so the knob is a build-time
+        # program-shape decision (off = byte-identical programs).
+        mode = str(config.tpu_health).lower()
+        if mode in ("off", "0", "false", "none", ""):
+            mode = "off"
+        elif mode in ("warn", "warning"):
+            mode = "warn"
+        elif mode in ("error", "raise", "strict"):
+            mode = "error"
+        else:
+            raise ValueError(
+                f"tpu_health={config.tpu_health!r} is not one of "
+                "off/warn/error")
+        self._health_mode = mode
+        self._health_armed = mode != "off"
+        self._health_every = max(int(config.tpu_health_every), 1)
+        self._health_tick = 0
+        self._health_vec = None           # device [3] nonfinite counts
+        self._health_pending_record = None  # slow-path replicated record
+
         # valid-set state precedes _build_grow: the memory model it
         # publishes accounts registered valid sets
         self._valid_sets: List = []
@@ -334,6 +369,7 @@ class GBDT:
         self._valid_bins: List = []  # device bins per valid set (fast path)
         self._note_hist_traffic()
         self._note_memory_model()
+        self._note_bin_occupancy()
 
     def _resolve_fused_grad(self):
         """The objective's pointwise gradient fn when the fused
@@ -449,6 +485,89 @@ class GBDT:
                 "memory preflight: " + report.render())
         from . import log
         log.warning("memory preflight: " + report.render())
+
+    def _note_bin_occupancy(self) -> None:
+        """Publish static bin-occupancy stats through obs meta (part of
+        the obs/health model-quality diagnostics): how much of the
+        [F, B] histogram capacity the binning actually uses, and how
+        many features binned down to a trivial single bin — a dataset
+        whose features collapse to 1-2 bins trains structurally blind
+        no matter what the loss curve says. Init-time only, always-on
+        like the traffic/memory models."""
+        try:
+            num_bins, _, _, _ = self.train_set.feature_meta_arrays()
+        except Exception:
+            return
+        nb = np.asarray(num_bins)
+        if nb.size == 0:
+            return
+        cap = max(int(self._static["max_bins"]), 1)
+        global_metrics.set_meta("health_bins", {
+            "features": int(nb.size),
+            "max_bins": cap,
+            "mean_bins": round(float(nb.mean()), 2),
+            "min_bins": int(nb.min()),
+            "bin_occupancy": round(float(nb.mean()) / cap, 4),
+            "trivial_features": int(np.sum(nb <= 1)),
+        })
+
+    # ------------------------------------------------------------------
+    # training-health hooks (obs/health.py; tpu_health knob)
+    def _health_end_iteration(self) -> None:
+        """Per-iteration health checks, run AFTER the iteration's
+        programs were dispatched: read the NaN/Inf sentinel counts
+        (one tiny [3] device->host transfer per check period), digest
+        replicated state across the mesh (drift sentinel), and refresh
+        the telemetry straggler probe. warn mode records + logs; error
+        mode raises NonFiniteError / DriftError — the structured alarms
+        this layer exists for."""
+        self._health_tick += 1
+        if self._health_tick % self._health_every:
+            self._health_vec = None
+            self._health_pending_record = None
+            return
+        gh = obs_health.global_health
+        vec, self._health_vec = self._health_vec, None
+        if vec is not None:
+            g, h, s = (int(x) for x in np.asarray(vec))
+            gh.note_sentinel(self.iter - 1, {"grad": g, "hess": h,
+                                             "scores": s},
+                             mode=self._health_mode)
+        mesh = getattr(self, "_shard_mesh", None)
+        if mesh is None:
+            mesh = getattr(self, "mesh", None)
+        if mesh is not None and getattr(mesh, "size", 1) > 1:
+            arrays = self._health_drift_arrays(mesh)
+            if arrays:
+                gh.check_drift(mesh, arrays, mode=self._health_mode,
+                               where=f"iteration {self.iter - 1}")
+        if gh.enabled:
+            gh.straggler_probe()
+
+    def _health_drift_arrays(self, mesh) -> Dict[str, object]:
+        """Replicated device state worth digest-comparing across the
+        mesh: the latest tree record (fast-path device records, or the
+        slow-path record stashed by _train_one_iter_impl before its
+        host transfer collapsed it to one device's copy) plus any
+        row-independent state the learner keeps fully replicated
+        (feature-parallel scores)."""
+        from .parallel.mesh import is_replicated_on
+        out: Dict[str, object] = {}
+        rec = None
+        if self._device_records:
+            rec = self._device_records[-1]
+        elif self._health_pending_record is not None:
+            rec = self._health_pending_record
+        self._health_pending_record = None
+        if rec is not None and is_replicated_on(mesh, rec.leaf_value):
+            out["tree_record"] = {"leaf_value": rec.leaf_value,
+                                  "leaf_count": rec.leaf_count,
+                                  "num_leaves": rec.num_leaves}
+        scores = self.scores
+        if isinstance(scores, jax.Array) and is_replicated_on(mesh,
+                                                              scores):
+            out["scores"] = scores
+        return out
 
     def _resolved_wave_max(self) -> int:
         """tpu_wave_max with -1 (auto) resolved: exact order for softmax
@@ -706,6 +825,7 @@ class GBDT:
         HLO as multi-hundred-MB literal constants and overflow compilation
         at Higgs scale."""
         grow = self._grow_partial()
+        sentinel = self._health_armed
 
         def fused(bins_fm, valid_bins, obj_state, scores, sample_mask,
                   valid_scores, it, lr):
@@ -716,13 +836,22 @@ class GBDT:
                 key = jax.random.fold_in(self._bagging_key, it)
                 sample_mask = self._sampling_in_jit(
                     jax.random.fold_in(key, 1), it, sample_mask)
+                sen_g = sen_h = None
                 if self._fused_grad_fn is not None:
                     # gradients fold into the histogram waves (see
                     # _grow_class_traced) — no [N] gradient buffers in
                     # this program at all
                     grad_all = hess_all = (None,)
+                    if sentinel:
+                        # NaN/Inf sentinel operands: the same pointwise
+                        # formula the grower evaluates — XLA CSEs the
+                        # two, so the fused path stays fused
+                        sen_g, sen_h = self._fused_grad_fn(
+                            scores[0], obj.label, obj.weight)
                 else:
                     grad_all, hess_all = self._grad_fn(scores)
+                    if sentinel:
+                        sen_g, sen_h = grad_all, hess_all
                 recs = []
                 new_valid = list(valid_scores)
                 for k in range(self.num_tree_per_iteration):
@@ -753,6 +882,13 @@ class GBDT:
                 out_state = (obj.device_state(evolving_only=True)
                              if obj is not None
                              else {"arrays": {}, "sub": {}})
+                if sentinel:
+                    # pure reductions as an EXTRA output: the training
+                    # math is untouched, so models are bit-identical
+                    # with the sentinel on vs off (tests assert)
+                    return (scores, sample_mask, tuple(new_valid),
+                            stacked, out_state,
+                            _nonfinite_counts(sen_g, sen_h, scores))
                 return (scores, sample_mask, tuple(new_valid), stacked,
                         out_state)
             finally:
@@ -770,11 +906,16 @@ class GBDT:
                 self._fused = self._make_fused()
         with global_tracer.span("train/iteration",
                                 block=lambda: self.scores):
-            (self.scores, self._sample_mask, valid, recs,
-             new_obj_state) = self._fused(
+            out = self._fused(
                 self.bins_fm, tuple(self._valid_bins), self._obj_state(),
                 self.scores, self._sample_mask, tuple(self._valid_scores),
                 jnp.int32(self.iter), jnp.float32(self.shrinkage_rate))
+            if self._health_armed:
+                (self.scores, self._sample_mask, valid, recs,
+                 new_obj_state, self._health_vec) = out
+            else:
+                (self.scores, self._sample_mask, valid, recs,
+                 new_obj_state) = out
         if self.objective is not None:
             self.objective.swap_device_state(new_obj_state)
         self._valid_scores = list(valid)
@@ -921,16 +1062,31 @@ class GBDT:
         if global_flusher.armed:  # LGBM_TPU_METRICS_FILE textfile egress
             global_flusher.maybe_flush()
         if not global_metrics.enabled:
-            return self._train_one_iter_impl(custom_grad, custom_hess)
+            if not self._health_armed:
+                return self._train_one_iter_impl(custom_grad, custom_hess)
+            # tpu_health without full telemetry: the sentinels run, the
+            # per-iteration metrics machinery stays off
+            stop = self._train_one_iter_impl(custom_grad, custom_hess)
+            self._health_end_iteration()
+            return stop
         global_metrics.begin_iteration(self.iter)
         n_dev0, n_host0 = len(self._device_records), len(self._host_models)
         self._observe_safely(self._observe_gradient_metrics,
                              custom_grad, custom_hess)
         try:
-            return self._train_one_iter_impl(custom_grad, custom_hess)
+            stop = self._train_one_iter_impl(custom_grad, custom_hess)
+            if self._health_armed:
+                # inside the try: a DriftError/NonFiniteError must
+                # propagate while the finally still closes the record
+                self._health_end_iteration()
+            return stop
         finally:
             self._observe_safely(self._observe_tree_metrics, n_dev0, n_host0)
             global_metrics.end_iteration()
+            if not self._health_armed and \
+                    obs_health.global_health.enabled:
+                # telemetry-only runs still get the straggler probe
+                obs_health.global_health.straggler_probe()
 
     @staticmethod
     def _observe_safely(fn, *args) -> None:
@@ -979,9 +1135,12 @@ class GBDT:
         finished, plus sampled-row count (telemetry-enabled path only)."""
         m = global_metrics
         gains = None
+        split_leaves = leaf_counts = None
         if len(self._device_records) > n_dev0:
             rec = self._device_records[-1]  # stacked [K, ...] TreeArrays
-            nl, gains = jax.device_get((rec.num_leaves, rec.split_gain))
+            nl, gains, split_leaves, leaf_counts = jax.device_get(
+                (rec.num_leaves, rec.split_gain, rec.split_leaf,
+                 rec.leaf_count))
             m.observe("leaves_grown", int(np.sum(nl)))
             gains = np.asarray(gains).reshape(-1)
         elif len(self._host_models) > n_host0:
@@ -991,12 +1150,35 @@ class GBDT:
             gains = np.concatenate(
                 [np.asarray(t.split_gain[:t.num_internal], np.float64)
                  for t in trees]) if trees else np.zeros(0)
+            leaf_counts = np.concatenate(
+                [np.asarray(t.leaf_count[:t.num_leaves], np.float64)
+                 for t in trees]) if trees else None
         if gains is not None:
             pos = gains[gains > 0]
             m.observe("splits_made", int(pos.size))
             if pos.size:
                 m.observe("best_gain", float(pos.max()))
                 m.observe("mean_split_gain", float(pos.mean()))
+                # gain DISTRIBUTION, not just the extremes: a healthy
+                # iteration's gain spectrum decays smoothly; a spectrum
+                # collapsing toward zero flags exhausted structure long
+                # before eval loss plateaus (obs/health diagnostics)
+                m.observe("gain_p50", float(np.percentile(pos, 50)))
+                m.observe("gain_p90", float(np.percentile(pos, 90)))
+        if split_leaves is not None:
+            depth_max = 0
+            for sl in np.asarray(split_leaves).reshape(
+                    -1, np.asarray(split_leaves).shape[-1]):
+                depths = obs_health.tree_depths(sl)
+                depth_max = max(depth_max, int(depths.max()))
+            m.observe("tree_depth_max", depth_max)
+        if leaf_counts is not None:
+            lc = np.asarray(leaf_counts, np.float64).reshape(-1)
+            lc = lc[lc > 0]
+            if lc.size:
+                m.observe("leaf_count_min", int(lc.min()))
+                m.observe("leaf_count_median", float(np.median(lc)))
+                m.observe("leaf_count_max", int(lc.max()))
         m.observe("sampled_rows", int(jnp.sum(self._sample_mask)))
 
     def _train_one_iter_impl(self, custom_grad=None,
@@ -1008,6 +1190,12 @@ class GBDT:
         with global_tracer.span("train/gradients",
                                 block=lambda: grad_all):
             grad_all, hess_all = self._gradients(custom_grad, custom_hess)
+        if self._health_armed:
+            # NaN/Inf sentinel payload, from the gradients this
+            # iteration is about to train on — no extra passes, the
+            # buffers are already live (obs/health.py)
+            self._health_vec = _nonfinite_counts(grad_all, hess_all,
+                                                 self.scores)
         with global_tracer.span("train/sampling"):
             self._resample_mask()
 
@@ -1038,6 +1226,12 @@ class GBDT:
                     self.bins_fm, grad, hess, mask, feature_mask,
                     self.feature_meta, self.hp, self.max_depth, self._forced,
                     node_key)
+            if self._health_armed:
+                # keep the REPLICATED device record alive until the
+                # end-of-iteration drift digest: the host transfer
+                # below reads one device's copy, which is exactly how
+                # a diverged replica would go unnoticed
+                self._health_pending_record = record
             if self._quant_enabled and \
                     self.config.quant_train_renew_leaf:
                 record = self._renew_leaves_in_jit(
@@ -1601,6 +1795,7 @@ class DART(GBDT):
         grow = self._grow_partial()
         xgb_mode = bool(self.config.xgboost_dart_mode)
         k_per = self.num_tree_per_iteration
+        sentinel = self._health_armed
 
         # the reference bakes the boost-from-average bias into the first
         # tree AFTER its score update (gbdt.cpp:426 AddBias), so dropped
@@ -1684,9 +1879,13 @@ class DART(GBDT):
                 factors = factors.at[t_cur].set(new_factor)
                 stacked = _stack_class_records(recs)
                 out_state = obj.device_state(evolving_only=True)
-                return (scores, sample_mask, tuple(new_valid), stacked,
+                outs = (scores, sample_mask, tuple(new_valid), stacked,
                         out_state, leaf_hist, tuple(new_vhists), leaf_vals,
                         factors)
+                if sentinel:  # see _make_fused: pure extra reductions
+                    outs = outs + (_nonfinite_counts(
+                        grad_all, hess_all, scores),)
+                return outs
             finally:
                 obj.swap_device_state(old_state)
 
@@ -1710,15 +1909,18 @@ class DART(GBDT):
         st = self._dart
         with global_tracer.span("train/iteration",
                                 block=lambda: self.scores):
-            (self.scores, self._sample_mask, valid, recs, new_obj_state,
-             st["leaf_hist"], vhist, st["leaf_vals"],
-             st["factors"]) = self._dart_fused(
+            out = self._dart_fused(
                 self.bins_fm, tuple(self._valid_bins), self._obj_state(),
                 self.scores, self._sample_mask, tuple(self._valid_scores),
                 st["leaf_hist"], tuple(st["vhist"]), st["leaf_vals"],
                 st["factors"], jnp.asarray(dropped), jnp.int32(n_drop),
                 jnp.int32(self._dart_t), jnp.int32(self.iter),
                 jnp.float32(self.config.learning_rate))
+            if self._health_armed:
+                out, self._health_vec = out[:-1], out[-1]
+            (self.scores, self._sample_mask, valid, recs, new_obj_state,
+             st["leaf_hist"], vhist, st["leaf_vals"],
+             st["factors"]) = out
         st["vhist"] = list(vhist)
         if self.objective is not None:
             self.objective.swap_device_state(new_obj_state)

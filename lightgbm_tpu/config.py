@@ -181,6 +181,8 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_dart_fused_max_bytes": [],
     "tpu_predict_chunk": ["predict_chunk", "predict_chunk_rows"],
     "tpu_preflight": ["preflight", "memory_preflight"],
+    "tpu_health": ["health", "training_health"],
+    "tpu_health_every": ["health_every", "health_check_every"],
     # serving knobs (serve/ subsystem)
     "serve_max_batch_rows": ["serve_max_batch"],
     "serve_max_wait_ms": ["serve_max_wait"],
@@ -549,6 +551,21 @@ class Config:
     # never judges. No effect on backends that report no memory stats
     # (CPU) unless LGBM_TPU_HBM_BYTES overrides the capacity.
     tpu_preflight: str = "warn"
+    # training-health sentinels (obs/health.py): per-iteration NaN/Inf
+    # sentinel counts folded into the fused training programs, plus
+    # cross-shard drift digests of replicated state on multi-device
+    # meshes. "off" (default) = guard-check-only no-op; "warn" records
+    # the finding (obs counters + a log warning) and keeps training;
+    # "error" raises the structured alarm (NonFiniteError / DriftError)
+    # at the iteration that produced it — a diverged or NaN-poisoned
+    # model fails fast instead of surfacing as a bad eval many
+    # iterations later. Trained model bytes are bit-identical on vs off
+    # (the sentinel adds pure reductions as extra program outputs).
+    tpu_health: str = "off"
+    # check period of the tpu_health sentinels (and of the telemetry
+    # straggler probe): every N iterations. 1 = every iteration; larger
+    # values amortize the tiny host sync the sentinel read costs.
+    tpu_health_every: int = 1
     # serving (serve/ async model server; task=serve and the in-process
     # API). Micro-batching: requests coalesce until serve_max_batch_rows
     # rows are pending or the OLDEST pending request has waited

@@ -83,6 +83,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 if is_valid_contain_train or cfg.is_provide_training_metric:
                     evaluation_result_list.extend(booster.eval_train(feval))
                 evaluation_result_list.extend(booster.eval_valid(feval))
+                if evaluation_result_list:
+                    # eval-loss anomaly detector (obs/health.py): one
+                    # attribute check when health isn't armed
+                    from .obs.health import global_health
+                    if global_health.enabled:
+                        global_health.note_evals(i, evaluation_result_list)
             try:
                 for cb in callbacks_after:
                     cb(callback_mod.CallbackEnv(
@@ -110,6 +116,7 @@ def _scoped_telemetry_enable(callbacks) -> Callable[[], None]:
     returns a restore function that puts the registry AND the tracer
     (switched on by metrics.enable()) back to their prior state, so the
     opt-in does not outlive the run it was requested for."""
+    from .obs.health import global_health
     from .obs.memory import global_watermarks
     from .obs.metrics import global_metrics
     from .obs.trace import global_tracer
@@ -120,6 +127,7 @@ def _scoped_telemetry_enable(callbacks) -> Callable[[], None]:
     metrics_was, tracer_was = global_metrics.enabled, global_tracer.enabled
     xla_was = global_xla.enabled
     watermarks_was = global_watermarks.enabled
+    health_was = global_health.enabled
     global_metrics.enable()
 
     def restore() -> None:
@@ -131,6 +139,8 @@ def _scoped_telemetry_enable(callbacks) -> Callable[[], None]:
                 global_xla.disable()
             if not watermarks_was:
                 global_watermarks.disable()
+            if not health_was:
+                global_health.disable()
     return restore
 
 

@@ -32,7 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .obs.metrics import global_metrics
+from .obs import health as obs_health
+from .obs.metrics import global_metrics  # noqa: F401  (re-export compat)
 from .ops import histogram as hist_ops
 from .ops import partition as part_ops
 from .ops import split as split_ops
@@ -196,9 +197,9 @@ def _sharded_pallas_build(shard_mesh, *, max_bins: int, dtype,
         hl = hist_ops.build_histogram(
             b_l, g_l, h_l, m_l, max_bins=max_bins, dtype=dtype,
             row_chunk=row_chunk, impl="pallas", precision=precision)
-        out = lax.psum(hl, axis)
-        global_metrics.note_collective("psum", out.size * out.dtype.itemsize)
-        return out
+        # tagged health wrapper: trace-time counters + runtime per-call
+        # attribution through the enclosing program's manifest
+        return obs_health.psum(hl, axis, tag="hist/psum")
 
     from .parallel.mesh import shard_map as _shard_map
     fn = _shard_map(local, mesh=shard_mesh,
@@ -239,9 +240,7 @@ def _sharded_pallas_multi(shard_mesh, *, max_bins: int,
         else:
             h = hist_pallas_multi(b_l, ghT_l, rl_l, ids, max_bins=max_bins,
                                   num_slots=ids.shape[0], precise=precision)
-        out = lax.psum(h, axis)
-        global_metrics.note_collective("psum", out.size * out.dtype.itemsize)
-        return out
+        return obs_health.psum(h, axis, tag="hist/psum_wave")
 
     from .parallel.mesh import shard_map as _shard_map
     fn = _shard_map(local, mesh=shard_mesh,

@@ -20,6 +20,12 @@ common.h:980,1044; global_timer dump at src/boosting/gbdt.cpp:29):
   ``cost_analysis()`` / ``memory_analysis()`` capture, compile
   wall-time, and per-phase/shape-bucket recompile attribution
   (``instrumented_jit`` at the program boundaries).
+- ``obs.health`` — training-health: runtime-attributed collective
+  byte/call counters with a timed mesh microprobe, host straggler-skew
+  attribution, cross-shard drift sentinels over replicated state
+  (``tpu_health=off/warn/error`` — warn records, error raises
+  ``DriftError``/``NonFiniteError``), per-iteration NaN/Inf sentinels
+  folded into the fused programs, and an eval-loss anomaly detector.
 - ``obs.export`` — OpenMetrics egress: the Prometheus text-format
   renderer over all of the above, the ``/metrics``+``/healthz``+
   ``/readyz`` HTTP endpoint, and the ``LGBM_TPU_METRICS_FILE``
@@ -39,6 +45,8 @@ from .memory import (PhaseWatermarks, PreflightError,  # noqa: F401
                      preflight_predict, train_memory_model)
 from .xla import (XlaIntrospector, aot_cost_summary,  # noqa: F401
                   global_xla, instrumented_jit)
+from .health import (DriftError, HealthError,  # noqa: F401
+                     HealthRegistry, NonFiniteError, global_health)
 from .export import (MetricsHTTPEndpoint,  # noqa: F401
                      MetricsTextfileFlusher, global_flusher,
                      render_openmetrics)
@@ -50,6 +58,8 @@ __all__ = ["Tracer", "global_tracer", "LatencyReservoir",
            "train_memory_model", "predict_memory_model",
            "preflight", "preflight_predict",
            "XlaIntrospector", "global_xla", "instrumented_jit",
-           "aot_cost_summary", "MetricsHTTPEndpoint",
+           "aot_cost_summary", "HealthError", "DriftError",
+           "NonFiniteError", "HealthRegistry", "global_health",
+           "MetricsHTTPEndpoint",
            "MetricsTextfileFlusher", "global_flusher",
            "render_openmetrics"]

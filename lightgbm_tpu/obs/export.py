@@ -207,6 +207,63 @@ def render_openmetrics(registry=None,
             doc.sample("lgbmtpu_xla_bytes_accessed", "gauge",
                        t["bytes_accessed"], labels={"tag": tag})
 
+    # training-health families (obs/health.py; empty summary — health
+    # never armed — emits nothing, asserted by tools/check_health.py)
+    from .health import global_health
+    hs = global_health.summary()
+    for tag in sorted(hs.get("collectives", {})):
+        ent = hs["collectives"][tag]
+        lab = {"tag": tag, "op": ent.get("op", "")}
+        doc.sample("lgbmtpu_health_collective_calls_total", "counter",
+                   ent.get("calls", 0), labels=lab,
+                   help_text="collectives actually issued at runtime, "
+                             "attributed per program call (obs/health.py)")
+        doc.sample("lgbmtpu_health_collective_bytes_total", "counter",
+                   ent.get("bytes", 0), labels=lab)
+    for op in sorted(hs.get("collective_probe", {})):
+        p = hs["collective_probe"][op]
+        doc.sample("lgbmtpu_health_collective_seconds_total", "counter",
+                   p.get("seconds", 0.0), labels={"op": op},
+                   help_text="device-synchronized wall time of the "
+                             "collective microprobe")
+        doc.sample("lgbmtpu_health_collective_probe_bytes_total",
+                   "counter", p.get("bytes", 0), labels={"op": op})
+    strag = hs.get("straggler") or {}
+    for phase in sorted(strag.get("phases", {})):
+        ph = strag["phases"][phase]
+        skew = ph.get("skew", 1.0)
+        if isinstance(skew, (int, float)) and skew == skew \
+                and skew not in (float("inf"),):
+            doc.sample("lgbmtpu_health_straggler_skew", "gauge", skew,
+                       labels={"phase": phase},
+                       help_text="per-phase max/median host-time skew "
+                                 "across shards (worst-shard ordinal in "
+                                 "the health summary)")
+    drift = hs.get("drift") or {}
+    if drift:
+        doc.sample("lgbmtpu_health_drift_checks_total", "counter",
+                   drift.get("checks", 0),
+                   help_text="cross-shard replicated-state digest "
+                             "comparisons run")
+        doc.sample("lgbmtpu_health_drift_mismatch_total", "counter",
+                   drift.get("mismatches", 0))
+    nf = hs.get("nonfinite") or {}
+    for kind in ("grad", "hess", "scores"):
+        if kind in nf:
+            doc.sample("lgbmtpu_health_nonfinite_total", "counter",
+                       nf[kind], labels={"kind": kind},
+                       help_text="NaN/Inf entries caught by the "
+                                 "per-iteration sentinel")
+    if nf:
+        doc.sample("lgbmtpu_health_nonfinite_iterations_total", "counter",
+                   nf.get("flagged_iterations", 0))
+    for kind in sorted(k for k in (hs.get("eval") or {})
+                       if k != "last"):
+        doc.sample("lgbmtpu_health_eval_anomalies_total", "counter",
+                   hs["eval"][kind], labels={"kind": kind},
+                   help_text="eval-loss anomaly flags "
+                             "(nan/spike/plateau)")
+
     for fam_name in sorted(extra_gauges or {}):
         doc.sample(fam_name, "gauge", extra_gauges[fam_name])
     return doc.text()

@@ -149,6 +149,10 @@ class MetricsRegistry:
         # per program boundary — obs/xla.py)
         from .xla import global_xla
         global_xla.enable()
+        # and the training-health registry (runtime collective
+        # attribution, straggler skew, eval anomalies — obs/health.py)
+        from .health import global_health
+        global_health.enable()
 
     def disable(self) -> None:
         self.enabled = False
@@ -244,10 +248,19 @@ class MetricsRegistry:
 
     def wrap_traced(self, tag: str, fn):
         """fn -> fn that notes a trace each time jax traces it; jit the
-        RESULT (``jax.jit(registry.wrap_traced("tag", f))``)."""
+        RESULT (``jax.jit(registry.wrap_traced("tag", f))``). Also opens
+        a health-manifest capture frame for the trace, so collective
+        call sites traced inside the body register themselves against
+        this program tag (obs/health.py runtime attribution) — trace
+        time only, never a per-call cost."""
         def wrapped(*args, **kwargs):
             self.note_trace(tag, top_level=True)
-            return fn(*args, **kwargs)
+            from .health import global_health
+            global_health.begin_program_trace(tag)
+            try:
+                return fn(*args, **kwargs)
+            finally:
+                global_health.end_program_trace(tag)
         wrapped.__name__ = getattr(fn, "__name__", tag)
         return wrapped
 

@@ -217,12 +217,13 @@ def instrumented_jit(tag: str, fn: Callable, phase: Optional[str] = None,
     cost analysis. Drop-in for the existing program-boundary jits
     (grower, fused iteration, predict traversal)."""
     import jax
+    from .health import global_health
     reg = registry if registry is not None else global_xla
     jitted = jax.jit(global_metrics.wrap_traced(tag, fn), **jit_kwargs)
     compiled_cache: Dict[Any, Any] = {}
     broken: List[str] = []  # non-empty => this tag fell back for good
 
-    def wrapper(*args, **kwargs):
+    def _dispatch(*args, **kwargs):
         if not reg.enabled or broken:
             return jitted(*args, **kwargs)
         try:
@@ -250,6 +251,18 @@ def instrumented_jit(tag: str, fn: Callable, phase: Optional[str] = None,
             reg.note_fallback(tag, repr(exc))
             return jitted(*args, **kwargs)
 
+    def wrapper(*args, **kwargs):
+        try:
+            return _dispatch(*args, **kwargs)
+        finally:
+            # runtime collective attribution (obs/health.py): AFTER the
+            # dispatch, so a first call's trace has already captured
+            # this program's collective manifest. One attribute check
+            # when health is disabled.
+            if global_health.enabled:
+                global_health.note_program_call(tag)
+
     wrapper.__name__ = getattr(fn, "__name__", tag)
     wrapper.__wrapped_jit__ = jitted  # escape hatch / tests
+    wrapper.lower = jitted.lower  # AOT-shaped callers (tests) keep working
     return wrapper

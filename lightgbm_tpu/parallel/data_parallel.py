@@ -45,6 +45,13 @@ class _DataParallelMixin:
         global_metrics.set_meta("mesh_size", int(self.mesh.size))
         global_metrics.set_meta("tree_learner",
                                 str(self.config.tree_learner))
+        # timed collective microprobe (obs/health.py): one psum + one
+        # all_gather over the real mesh, device-synchronized — the
+        # measured per-byte rate the runtime byte counters are priced
+        # with. Health-enabled runs only; never on a 1-device mesh.
+        from ..obs.health import global_health
+        if global_health.enabled and self.mesh.size > 1:
+            global_health.probe_collectives(self.mesh)
 
     def _setup_sharding_inner(self, num_shards: int):
         self.mesh = mesh_lib.get_mesh(num_shards)
@@ -306,6 +313,11 @@ class FeatureParallelGBDT(GBDT):
                 return grow(bins, g, h, m, fm, meta, hp, md)
             self._grow = _grow_adapter
             self._fused = None
+            global_metrics.set_meta("mesh_size", int(self.mesh.size))
+            global_metrics.set_meta("tree_learner", "feature")
+            from ..obs.health import global_health
+            if global_health.enabled:
+                global_health.probe_collectives(self.mesh)
 
     def _fast_path_ok(self, custom_grad) -> bool:
         return False

@@ -24,6 +24,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..learner import TreeArrays, _LeafSplits, _store_split
+from ..obs import health as obs_health
+from ..obs import xla as obs_xla
 from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
 from ..ops import split as split_ops
@@ -33,12 +35,16 @@ from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams,
 from . import mesh as mesh_lib
 
 
-def _sync_best_split(info: SplitInfo, feat_offset, axis_name) -> SplitInfo:
+def _sync_best_split(info: SplitInfo, feat_offset, axis_name,
+                     loop_factor: int = 1) -> SplitInfo:
     """All-gather per-shard winners, keep the globally best
-    (ref: feature_parallel_tree_learner.cpp:63 SyncUpGlobalBestSplit)."""
+    (ref: feature_parallel_tree_learner.cpp:63 SyncUpGlobalBestSplit).
+    loop_factor: static trip count of the enclosing scan, for the
+    health wrappers' runtime byte/call attribution."""
     info = info._replace(feature=info.feature + feat_offset)
-    gathered = jax.tree_util.tree_map(
-        lambda x: lax.all_gather(x, axis_name), info)  # each field [W]
+    gathered = obs_health.all_gather(
+        info, axis_name, tag="split/all_gather",
+        loop_factor=loop_factor)  # each field [W]
     winner = jnp.argmax(gathered.gain)
     return jax.tree_util.tree_map(lambda x: x[winner], gathered)
 
@@ -192,10 +198,12 @@ def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,
         pen_depth = child_depth - 1
         split_l = sync(find_best_split(left_hist, lg, lh, lc, meta_loc,
                                        hp, fmask_loc, out_l, l_min, l_max,
-                                       pen_depth, has_categorical))
+                                       pen_depth, has_categorical),
+                       loop_factor=L - 1)
         split_r = sync(find_best_split(right_hist, rg, rh, rc, meta_loc,
                                        hp, fmask_loc, out_r, r_min, r_max,
-                                       pen_depth, has_categorical))
+                                       pen_depth, has_categorical),
+                       loop_factor=L - 1)
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         split_l = split_l._replace(
             gain=jnp.where(depth_ok, split_l.gain, K_MIN_SCORE))
@@ -267,4 +275,7 @@ def make_sharded_feature_grow(mesh, *, num_leaves: int, max_bins: int,
         grow, mesh=mesh,
         in_specs=(rep, rep, rep, rep, rep, meta_spec, hp_spec, rep),
         out_specs=(tree_spec, rep))
-    return jax.jit(sharded)
+    # instrumented boundary: health manifests attribute the per-split
+    # SplitInfo all_gathers per runtime call (see parallel/voting.py)
+    return obs_xla.instrumented_jit("parallel/feature_grow", sharded,
+                                    phase="grow")

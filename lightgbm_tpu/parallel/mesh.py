@@ -88,6 +88,22 @@ def data_sharding(mesh: Mesh, ndim: int, row_axis: int = 0) -> NamedSharding:
     return NamedSharding(mesh, P(*spec))
 
 
+def is_replicated_on(mesh: Mesh, array) -> bool:
+    """True when `array` physically holds a full copy on every device of
+    `mesh` — the precondition for the cross-shard drift sentinels
+    (obs/health.py): only state that is SUPPOSED to be identical on
+    every chip can meaningfully be digest-compared across them."""
+    sharding = getattr(array, "sharding", None)
+    if sharding is None or not getattr(sharding, "is_fully_replicated",
+                                       False):
+        return False
+    try:
+        devices = set(sharding.device_set)
+    except Exception:
+        return False
+    return set(mesh.devices.flat).issubset(devices)
+
+
 def pad_rows_to_shards(n: int, mesh: Mesh) -> int:
     """Smallest row count >= n divisible by the mesh's data axis (row
     blocks fed to shard_map must split evenly across devices)."""

@@ -26,7 +26,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..learner import TreeArrays, _LeafSplits, _store_split
-from ..obs.metrics import global_metrics
+from ..obs import health as obs_health
+from ..obs import xla as obs_xla
 from ..ops import histogram as hist_ops
 from ..ops import partition as part_ops
 from ..ops import split as split_ops
@@ -35,14 +36,6 @@ from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams,
                          find_best_split, leaf_output, per_feature_best_gain,
                          propagate_monotone_bounds)
 from . import mesh as mesh_lib
-
-
-def _note_collective(op: str, arr: jax.Array) -> None:
-    """Trace-time collective accounting: runs once per compiled program
-    (shapes are static under the trace), feeding obs.metrics the per-
-    program ICI byte/call profile — the static analog of the reference's
-    per-split network counters (network.cpp Allreduce sizes)."""
-    global_metrics.note_collective(op, arr.size * arr.dtype.itemsize)
 
 
 def _local_leaf_sums(local_hist: jax.Array):
@@ -55,13 +48,18 @@ def _local_leaf_sums(local_hist: jax.Array):
 def _vote_and_reduce(local_hist, pg, ph, pc, parent_out, min_b, max_b,
                      depth, meta, hp, feature_mask, *,
                      num_candidates: int, top_k: int, axis_name: str,
-                     has_categorical: bool = True):
+                     has_categorical: bool = True, loop_factor: int = 1):
     """One voting round for one leaf: local top-k proposal -> global vote
     -> candidate-only histogram psum -> global best split.
 
     local_hist: [F, B, 3] this shard's histogram for the leaf.
     pg/ph/pc: GLOBAL leaf sums (replicated). Returns a SplitInfo whose
     `feature` is a real feature index.
+
+    loop_factor: static trip count of the enclosing ``lax.scan`` (the
+    per-split step body) — the health wrappers attribute this many
+    issued collectives per program run, so the runtime byte/call
+    counters match what the ICI actually carries.
     """
     lg, lh, lc = _local_leaf_sums(local_hist)
     local_gain = per_feature_best_gain(local_hist, lg, lh, lc, meta, hp,
@@ -72,21 +70,23 @@ def _vote_and_reduce(local_hist, pg, ph, pc, parent_out, min_b, max_b,
 
     # --- vote: each shard proposes its top-k features
     _, prop = lax.top_k(local_gain, top_k)                    # [k]
-    all_props = lax.all_gather(prop, axis_name).reshape(-1)    # [W*k]
-    _note_collective("all_gather", all_props)
+    all_props = obs_health.all_gather(
+        prop, axis_name, tag="vote/all_gather",
+        loop_factor=loop_factor).reshape(-1)                   # [W*k]
     votes = jnp.zeros((num_features,), jnp.float32).at[all_props].add(1.0)
     # tie-break votes by the summed local gains (deterministic; the
     # reference breaks ties arbitrarily by machine order)
-    gain_sum = lax.psum(jnp.maximum(local_gain, K_MIN_SCORE * 1e-3),
-                        axis_name)
-    _note_collective("psum", gain_sum)
+    gain_sum = obs_health.psum(jnp.maximum(local_gain, K_MIN_SCORE * 1e-3),
+                               axis_name, tag="vote/psum_gain",
+                               loop_factor=loop_factor)
     norm = jnp.max(jnp.abs(gain_sum)) + 1.0
     _, cand = lax.top_k(votes + gain_sum / (norm * 4.0), num_candidates)
     cand = cand.astype(jnp.int32)                              # [C]
 
     # --- reduce only the candidates' histograms (ref: :396)
-    cand_hist = lax.psum(local_hist[cand], axis_name)          # [C, B, 3]
-    _note_collective("psum", cand_hist)
+    cand_hist = obs_health.psum(local_hist[cand], axis_name,
+                                tag="vote/psum_hist",
+                                loop_factor=loop_factor)       # [C, B, 3]
     cand_meta = jax.tree_util.tree_map(lambda a: a[cand], meta)
     info = find_best_split(cand_hist, pg, ph, pc, cand_meta, hp,
                            feature_mask[cand], parent_out, min_b, max_b,
@@ -130,12 +130,11 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
     # --- root: local histogram; global sums by psum (ref: data_parallel
     # root Allreduce, data_parallel_tree_learner.cpp:170)
     root_hist = build(bins_fm, grad, hess, sample_mask)
-    root_g = lax.psum(jnp.sum(grad * sample_mask, dtype=f32), axis_name)
-    root_h = lax.psum(jnp.sum(hess * sample_mask, dtype=f32), axis_name)
-    root_c = lax.psum(jnp.sum(sample_mask, dtype=f32), axis_name)
-    _note_collective("psum", root_g)
-    _note_collective("psum", root_h)
-    _note_collective("psum", root_c)
+    root_g, root_h, root_c = obs_health.psum(
+        (jnp.sum(grad * sample_mask, dtype=f32),
+         jnp.sum(hess * sample_mask, dtype=f32),
+         jnp.sum(sample_mask, dtype=f32)),
+        axis_name, tag="root/psum")
     root_out = leaf_output(root_g, root_h, hp)
     neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
     root_split = vote(root_hist, root_g, root_h, root_c, root_out,
@@ -243,9 +242,11 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
 
         child_depth = leaves.depth[best_leaf] + 1
         pen_depth = child_depth - 1
-        split_l = vote(left_hist, lg, lh, lc, out_l, l_min, l_max, pen_depth)
+        # inside the L-1-trip split scan: traced once, issued L-1 times
+        split_l = vote(left_hist, lg, lh, lc, out_l, l_min, l_max,
+                       pen_depth, loop_factor=L - 1)
         split_r = vote(right_hist, rg, rh, rc, out_r, r_min, r_max,
-                       pen_depth)
+                       pen_depth, loop_factor=L - 1)
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         split_l = split_l._replace(
             gain=jnp.where(depth_ok, split_l.gain, K_MIN_SCORE))
@@ -318,4 +319,7 @@ def make_sharded_voting_grow(mesh, *, num_leaves: int, max_bins: int,
         grow, mesh=mesh,
         in_specs=(data, rows, rows, rows, rep, meta_spec, hp_spec, rep),
         out_specs=(tree_spec, rows))
-    return jax.jit(sharded)
+    # instrumented program boundary: recompile attribution + the health
+    # manifest that attributes this program's collectives per call
+    return obs_xla.instrumented_jit("parallel/voting_grow", sharded,
+                                    phase="grow")

@@ -1,0 +1,471 @@
+"""Training-health observability (obs/health.py; ISSUE 10): cross-shard
+drift sentinels under injected divergence, NaN/Inf sentinels on poisoned
+gradients, runtime-attributed collective counters (the PR-1 trace-time
+counters' steady-state fix), straggler-skew math, the eval-loss anomaly
+detector, bit-identity of trained models with health on vs off, and the
+check_health / perf-gate wiring."""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.obs.health import (DriftError, NonFiniteError,
+                                     global_health, tree_depths)
+from lightgbm_tpu.parallel import mesh as mesh_lib
+
+from conftest import make_binary, make_regression
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_health():
+    global_health.reset()
+    was = global_health.enabled
+    yield
+    global_health.enabled = was
+    global_health.reset()
+
+
+def _diverged_replicated(mesh, host, bad_shard, delta=1.0):
+    """A fully-replicated array whose copy on `bad_shard` is perturbed —
+    the physical state of a silently-diverged replica."""
+    copies = []
+    for i, dev in enumerate(mesh.devices.flat):
+        h = host.copy()
+        if i == bad_shard:
+            h.flat[0] += delta
+        copies.append(jax.device_put(h, dev))
+    return jax.make_array_from_single_device_arrays(
+        host.shape, NamedSharding(mesh, P()), copies)
+
+
+# ---------------------------------------------------------------------------
+class TestDriftSentinel:
+    def test_injected_divergence_detected_and_attributed(self):
+        mesh = mesh_lib.get_mesh(8)
+        arr = _diverged_replicated(mesh, np.arange(32, dtype=np.float32), 3)
+        mm = global_health.check_drift(mesh, {"scores": arr}, mode="warn")
+        assert [(m["name"], m["shards"]) for m in mm] == [("scores", [3])]
+        assert global_health.drift_mismatches == 1
+        assert global_health.last_drift["mismatches"][0]["shards"] == [3]
+
+    def test_error_mode_raises_drift_error(self):
+        mesh = mesh_lib.get_mesh(8)
+        arr = _diverged_replicated(mesh, np.ones(16, np.float32), 6)
+        with pytest.raises(DriftError, match=r"shard\(s\) \[6\]"):
+            global_health.check_drift(mesh, {"state": arr}, mode="error")
+
+    def test_clean_replica_passes_even_with_nans(self):
+        """Identical NaN state on every shard is consistent, not drift
+        (NaNs are zeroed from the sums and counted separately)."""
+        mesh = mesh_lib.get_mesh(8)
+        host = np.arange(16, dtype=np.float32)
+        host[2] = np.nan
+        arr = jax.device_put(host, NamedSharding(mesh, P()))
+        assert global_health.check_drift(mesh, {"s": arr},
+                                         mode="error") == []
+
+    def test_majority_vote_names_the_bad_shard_even_shard0(self):
+        mesh = mesh_lib.get_mesh(8)
+        arr = _diverged_replicated(mesh, np.ones(8, np.float32), 0)
+        mm = global_health.check_drift(mesh, {"s": arr}, mode="warn")
+        assert mm[0]["shards"] == [0]
+
+    def test_two_shard_tie_reports_both_not_an_arbitrary_loser(self):
+        """On a diverged 2-shard mesh the replicas are indistinguishable
+        — both must be reported, never just the insertion-order loser."""
+        mesh = mesh_lib.get_mesh(2)
+        try:
+            arr = _diverged_replicated(mesh, np.ones(8, np.float32), 0)
+            mm = global_health.check_drift(mesh, {"s": arr}, mode="warn")
+            assert mm[0]["shards"] == [0, 1]
+        finally:
+            mesh_lib.get_mesh(8)  # restore the shared 8-device mesh
+
+    def test_booster_drift_detected_at_the_right_iteration(self):
+        """tpu_health=error on the feature-parallel learner (replicated
+        scores): healthy iterations pass, then one device's replica is
+        perturbed and the NEXT iteration's end-of-iteration digest must
+        raise DriftError; the warn-mode twin records instead."""
+        X, y = make_binary(512)
+        params = {"objective": "binary", "tree_learner": "feature",
+                  "tpu_num_shards": 8, "num_leaves": 7, "tpu_wave_max": 0,
+                  "min_data_in_leaf": 5, "verbosity": -1}
+        bst = lgb.Booster({**params, "tpu_health": "error"},
+                          lgb.Dataset(X, label=y))
+        assert not bst.update()
+        assert not bst.update()  # clean replicas: no alarm
+        g = bst._gbdt
+        g.scores = _diverged_replicated(g.mesh, np.asarray(g.scores), 2)
+        with pytest.raises(DriftError, match="iteration 2"):
+            bst.update()
+        assert global_health.drift_mismatches >= 1
+
+        global_health.reset()
+        bst_w = lgb.Booster({**params, "tpu_health": "warn"},
+                            lgb.Dataset(X, label=y))
+        bst_w.update()
+        gw = bst_w._gbdt
+        gw.scores = _diverged_replicated(gw.mesh, np.asarray(gw.scores), 5)
+        assert not bst_w.update()  # warn keeps training
+        assert global_health.drift_mismatches >= 1
+        assert global_health.last_drift["where"] == "iteration 1"
+
+
+# ---------------------------------------------------------------------------
+class TestNaNSentinel:
+    def test_fast_path_error_raises_within_one_iteration(self):
+        X, _ = make_regression(512)
+        y = X[:, 0].astype(np.float64).copy()
+        y[11] = np.nan  # one poisoned label -> NaN L2 gradient
+        with pytest.raises(NonFiniteError, match="iteration 0"):
+            lgb.train({"objective": "regression", "verbosity": -1,
+                       "tpu_health": "error", "num_leaves": 7},
+                      lgb.Dataset(X, label=y), num_boost_round=3)
+
+    def test_warn_mode_records_and_keeps_training(self):
+        X, _ = make_regression(512)
+        y = X[:, 0].astype(np.float64).copy()
+        y[11] = np.nan
+        bst = lgb.train({"objective": "regression", "verbosity": -1,
+                         "tpu_health": "warn", "num_leaves": 7},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+        assert bst.current_iteration() == 2
+        assert global_health.nonfinite.get("grad", 0) >= 1
+        assert global_health.nonfinite_iterations == 2
+        assert global_health.last_nonfinite["iteration"] == 1
+
+    def test_slow_path_custom_gradients(self):
+        """Custom fobj (slow path): the sentinel reads the gradient
+        buffers that are already live — NaN custom grads trip it too."""
+        X, y = make_binary(256)
+        bst = lgb.Booster({"objective": "none", "verbosity": -1,
+                           "tpu_health": "warn", "num_leaves": 7},
+                          lgb.Dataset(X, label=y))
+
+        def fobj(preds, ds):
+            g = preds - y
+            g[3] = np.nan
+            return g, np.ones_like(g)
+
+        bst.update(fobj=fobj)
+        assert global_health.nonfinite.get("grad", 0) >= 1
+
+    def test_health_every_skips_intermediate_iterations(self):
+        X, _ = make_regression(300)
+        y = X[:, 0].astype(np.float64).copy()
+        y[0] = np.nan
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "tpu_health": "warn", "tpu_health_every": 2,
+                   "num_leaves": 7},
+                  lgb.Dataset(X, label=y), num_boost_round=4)
+        # checks fire on every 2nd tick only
+        assert global_health.nonfinite_iterations == 2
+
+
+# ---------------------------------------------------------------------------
+class TestBitIdentity:
+    @staticmethod
+    def _strip_params(model_str):
+        return "\n".join(l for l in model_str.splitlines()
+                         if "tpu_health" not in l)
+
+    def test_model_bytes_identical_health_on_vs_off(self):
+        """The sentinel adds pure reductions as extra program outputs;
+        the trained trees must be bit-identical (only the echoed
+        params line may differ)."""
+        X, y = make_binary(512)
+        params = {"objective": "binary", "verbosity": -1, "num_leaves": 7}
+        off = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                        num_boost_round=4).model_to_string()
+        on = lgb.train({**params, "tpu_health": "error"},
+                       lgb.Dataset(X, label=y),
+                       num_boost_round=4).model_to_string()
+        assert self._strip_params(off) == self._strip_params(on)
+
+    def test_disabled_path_is_guard_check_only(self, monkeypatch):
+        """With health off nothing may reach the registry: break every
+        recording entry point and train."""
+        def boom(*a, **k):
+            raise AssertionError("health touched while disabled")
+        monkeypatch.setattr(global_health, "note_sentinel", boom)
+        monkeypatch.setattr(global_health, "check_drift", boom)
+        monkeypatch.setattr(global_health, "note_program_call", boom)
+        monkeypatch.setattr(global_health, "straggler_probe", boom)
+        global_health.disable()
+        X, y = make_binary(256)
+        bst = lgb.train({"objective": "binary", "verbosity": -1,
+                         "num_leaves": 7},
+                        lgb.Dataset(X, label=y), num_boost_round=2)
+        assert bst.current_iteration() == 2
+        assert global_health.summary() == {}
+
+    def test_unknown_health_mode_rejected(self):
+        X, y = make_binary(128)
+        with pytest.raises(ValueError, match="tpu_health"):
+            lgb.Booster({"objective": "binary", "verbosity": -1,
+                         "tpu_health": "sometimes"},
+                        lgb.Dataset(X, label=y))
+
+
+# ---------------------------------------------------------------------------
+class TestRuntimeCollectives:
+    def test_steady_state_counters_match_issued_calls(self):
+        """The satellite fix of the PR-1 counters: trace-time counters
+        freeze after the first compile, the health runtime counters
+        must keep advancing by exactly one manifest per program call."""
+        global_health.enable()
+        X, y = make_binary(512)
+        bst = lgb.Booster({"objective": "binary", "tree_learner": "voting",
+                           "top_k": 2, "tpu_num_shards": 8,
+                           "num_leaves": 7, "tpu_wave_max": 0,
+                           "min_data_in_leaf": 5, "verbosity": -1},
+                          lgb.Dataset(X, label=y))
+        bst.update()
+        snap1 = {t: dict(v) for t, v in global_health.runtime.items()}
+        assert snap1, "no runtime collective attribution recorded"
+        # root vote once + two votes per step, traced once but issued
+        # L-1 times via the loop factor
+        L = 7
+        assert snap1["vote/all_gather"]["calls"] == 1 + 2 * (L - 1)
+        assert snap1["vote/psum_hist"]["bytes"] > 0
+        bst.update()  # steady state: no retrace, counters must still move
+        for tag, ent in snap1.items():
+            now = global_health.runtime[tag]
+            assert now["calls"] == 2 * ent["calls"], tag
+            assert now["bytes"] == 2 * ent["bytes"], tag
+
+    def test_collective_probe_records_timing(self):
+        global_health.enable()
+        mesh = mesh_lib.get_mesh(8)
+        out = global_health.probe_collectives(mesh)
+        assert set(out) == {"psum", "all_gather"}
+        for op in ("psum", "all_gather"):
+            assert global_health.probe[op]["seconds"] > 0
+            assert global_health.probe[op]["bytes"] > 0
+
+    def test_feature_parallel_all_gather_attributed(self):
+        global_health.enable()
+        X, y = make_binary(512)
+        bst = lgb.Booster({"objective": "binary",
+                           "tree_learner": "feature",
+                           "tpu_num_shards": 8, "num_leaves": 7,
+                           "tpu_wave_max": 0, "min_data_in_leaf": 5,
+                           "verbosity": -1}, lgb.Dataset(X, label=y))
+        bst.update()
+        ent = global_health.runtime.get("split/all_gather")
+        assert ent and ent["op"] == "all_gather"
+        assert ent["calls"] == 1 + 2 * (7 - 1)
+
+
+# ---------------------------------------------------------------------------
+class TestStraggler:
+    def test_skew_math_and_worst_ordinal(self):
+        s = global_health.straggler_from_matrix(
+            ["grow", "update"],
+            [[0.1, 0.2], [0.1, 0.2], [0.4, 0.2], [0.1, 0.2]])
+        assert s["n_hosts"] == 4
+        assert s["phases"]["grow"]["skew"] == pytest.approx(4.0)
+        assert s["phases"]["grow"]["worst"] == 2
+        assert s["phases"]["update"]["skew"] == pytest.approx(1.0)
+        assert s["max_skew"] == pytest.approx(4.0)
+        assert s["worst_phase"] == "grow"
+
+    def test_probe_merges_worst_skew_across_probes(self):
+        global_health.straggler_probe({"grow": 0.0})  # nothing yet
+        global_health.straggler_probe({"grow": 0.5})
+        first = global_health.straggler["phases"]["grow"]["skew"]
+        # later quiet probe must not erase the recorded phase
+        global_health.straggler_probe({"update": 0.1})
+        assert "grow" in global_health.straggler["phases"]
+        assert global_health.straggler["phases"]["grow"]["skew"] == first
+        assert "update" in global_health.straggler["phases"]
+
+    def test_tracer_fed_probe_single_host(self):
+        from lightgbm_tpu.obs.trace import global_tracer
+        was = global_tracer.enabled
+        global_tracer.enable()
+        try:
+            with global_tracer.span("health_test/phase"):
+                pass
+            s = global_health.straggler_probe()
+            assert s is not None and s["n_hosts"] == 1
+        finally:
+            if not was:
+                global_tracer.disable()
+
+
+# ---------------------------------------------------------------------------
+class TestEvalAnomalies:
+    def test_nan_flag(self):
+        assert global_health.note_eval(0, "v", "l2", float("nan")) == \
+            ["nan"]
+        assert global_health.eval_anomalies["nan"] == 1
+
+    def test_spike_flag(self):
+        for i in range(6):
+            global_health.note_eval(i, "v", "l2", 1.0)
+        flags = global_health.note_eval(6, "v", "l2", 2.0)
+        assert "spike" in flags
+        # higher-is-better metrics spike DOWNWARD
+        for i in range(6):
+            global_health.note_eval(i, "v", "auc", 0.9, True)
+        assert "spike" in global_health.note_eval(6, "v", "auc", 0.3, True)
+
+    def test_plateau_flag(self):
+        flags = []
+        for i in range(12):
+            flags = global_health.note_eval(i, "v", "l2", 0.5)
+        assert "plateau" in flags
+
+    def test_engine_feeds_eval_results(self):
+        global_health.enable()
+        X, y = make_regression(400)
+        Xv, yv = make_regression(200, seed=1)
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "num_leaves": 7},
+                  lgb.Dataset(X, label=y), num_boost_round=3,
+                  valid_sets=[lgb.Dataset(Xv, label=yv)])
+        assert any(k.startswith("valid_0/") for k in
+                   global_health._eval_hist)
+
+
+# ---------------------------------------------------------------------------
+class TestDiagnostics:
+    def test_tree_depths_chain(self):
+        d = tree_depths(np.asarray([0, 1, 2]))
+        assert d.tolist() == [1, 2, 3, 3]
+        assert tree_depths(np.asarray([-1, -1])).tolist() == [0]
+
+    def test_bin_occupancy_meta_published(self):
+        from lightgbm_tpu.obs.metrics import global_metrics
+        X, y = make_binary(512)
+        lgb.Booster({"objective": "binary", "verbosity": -1,
+                     "max_bin": 63}, lgb.Dataset(X, label=y))
+        hb = global_metrics.meta.get("health_bins")
+        assert hb and hb["features"] == 8
+        assert 0 < hb["bin_occupancy"] <= 1.0
+        assert hb["trivial_features"] == 0
+
+    def test_telemetry_iteration_carries_distributions(self):
+        from lightgbm_tpu.callback import record_telemetry
+        X, y = make_binary(512)
+        rec = {}
+        lgb.train({"objective": "binary", "verbosity": -1,
+                   "num_leaves": 15},
+                  lgb.Dataset(X, label=y), num_boost_round=2,
+                  callbacks=[record_telemetry(rec)])
+        last = {k: v[-1] for k, v in rec.items()
+                if v and v[-1] is not None}
+        assert last["tree_depth_max"] >= 1
+        assert last["gain_p50"] <= last["gain_p90"] <= last["best_gain"]
+        assert last["leaf_count_min"] <= last["leaf_count_median"] \
+            <= last["leaf_count_max"]
+
+    def test_replicated_detector(self):
+        mesh = mesh_lib.get_mesh(8)
+        rep = jax.device_put(np.ones(8, np.float32),
+                             NamedSharding(mesh, P()))
+        assert mesh_lib.is_replicated_on(mesh, rep)
+        sharded = mesh_lib.shard_data(mesh, np.ones(64, np.float32), 0)
+        assert not mesh_lib.is_replicated_on(mesh, sharded)
+        assert not mesh_lib.is_replicated_on(mesh, np.ones(4))
+
+
+# ---------------------------------------------------------------------------
+class TestOpenMetricsAndTools:
+    def test_health_families_render_and_validate(self):
+        from lightgbm_tpu.obs.export import render_openmetrics
+        from check_metrics_endpoint import validate_exposition
+        mesh = mesh_lib.get_mesh(8)
+        global_health.enable()
+        global_health.probe_collectives(mesh)
+        global_health.straggler_probe({"grow": 0.2})
+        arr = _diverged_replicated(mesh, np.ones(8, np.float32), 1)
+        global_health.check_drift(mesh, {"s": arr}, mode="warn")
+        global_health.note_sentinel(3, {"grad": 2, "hess": 0,
+                                        "scores": 0}, mode="warn")
+        global_health.note_eval(0, "v", "l2", float("nan"))
+        text = render_openmetrics()
+        errors, families = validate_exposition(text)
+        assert not errors, errors[:5]
+        for fam in ("lgbmtpu_health_collective_seconds_total",
+                    "lgbmtpu_health_straggler_skew",
+                    "lgbmtpu_health_drift_mismatch_total",
+                    "lgbmtpu_health_nonfinite_total",
+                    "lgbmtpu_health_eval_anomalies_total"):
+            assert fam in families, fam
+
+    def test_disabled_summary_empty_and_no_families(self):
+        from lightgbm_tpu.obs.export import render_openmetrics
+        assert global_health.summary() == {}
+        assert "lgbmtpu_health_" not in render_openmetrics()
+
+    def test_check_health_tool(self):
+        import check_health
+        assert check_health.main() == 0
+
+    def test_bench_health_fold_shape_is_json(self):
+        """The summary bench.py folds must be JSON-serializable."""
+        mesh = mesh_lib.get_mesh(8)
+        global_health.enable()
+        global_health.probe_collectives(mesh)
+        global_health.straggler_probe({"grow": 0.3})
+        json.dumps(global_health.summary())
+
+
+# ---------------------------------------------------------------------------
+class TestPerfGateHealthCheck:
+    @staticmethod
+    def _rec(health):
+        return {"metric": "boosting_iters_per_sec_higgs_shape",
+                "value": 50.0, "vs_baseline": 13.0,
+                "unit": "iters/sec (N=10500000)",
+                "hist_bytes_reduction": 1.35,
+                "health": health}
+
+    def test_skew_over_ceiling_fails(self, tmp_path, capsys):
+        import check_perf_gate
+        cand = tmp_path / "BENCH_candidate.json"
+        cand.write_text(json.dumps(self._rec({
+            "straggler": {"phases": {"train/grow": {
+                "max_s": 1.0, "median_s": 0.1, "skew": 10.0,
+                "worst": 3}}, "max_skew": 10.0}})))
+        assert check_perf_gate.main([str(cand)]) == 1
+        assert "straggler skew" in capsys.readouterr().out
+
+    def test_collective_share_over_ceiling_fails(self, tmp_path, capsys):
+        import check_perf_gate
+        cand = tmp_path / "BENCH_candidate.json"
+        cand.write_text(json.dumps(self._rec({
+            "collectives_est": {"est_seconds": 9.0, "train_seconds": 10.0,
+                                "time_share": 0.9}})))
+        assert check_perf_gate.main([str(cand)]) == 1
+        assert "collective time share" in capsys.readouterr().out
+
+    def test_healthy_summary_passes(self, tmp_path, capsys):
+        import check_perf_gate
+        cand = tmp_path / "BENCH_candidate.json"
+        cand.write_text(json.dumps(self._rec({
+            "straggler": {"phases": {"train/grow": {
+                "max_s": 1.0, "median_s": 0.9, "skew": 1.11,
+                "worst": 0}}, "max_skew": 1.11},
+            "collectives_est": {"est_seconds": 0.5,
+                                "train_seconds": 10.0,
+                                "time_share": 0.05}})))
+        assert check_perf_gate.main([str(cand)]) == 0
+        assert "straggler phase(s) checked" in capsys.readouterr().out
+
+    def test_no_health_summaries_skips(self, capsys):
+        import check_perf_gate
+        assert check_perf_gate.main([]) == 0
+        assert "health check skipped" in capsys.readouterr().out

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""CI smoke for the training-health observability layer (obs/health.py).
+
+Four steps, in order:
+
+1. **Disabled path emits nothing** — before anything arms health, a
+   plain training run must leave ``global_health.summary()`` empty and
+   ``render_openmetrics()`` free of any ``lgbmtpu_health_*`` family.
+
+2. **Health families present** — with telemetry + health armed, a
+   mesh (data-parallel) training run plus a drift check, straggler
+   probe and collective microprobe must surface the
+   ``lgbmtpu_health_*`` families in the OpenMetrics document, and the
+   whole document must stay valid Prometheus exposition line by line
+   (reusing check_metrics_endpoint.validate_exposition).
+
+3. **NaN sentinel fires on a poisoned-label fixture** — one NaN label
+   in an L2 regression makes a NaN gradient; ``tpu_health=warn`` must
+   record it within the first iteration, ``tpu_health=error`` must
+   raise ``NonFiniteError``.
+
+4. **Drift sentinel fires on injected divergence** — a replicated
+   array rebuilt with one device's copy perturbed must be flagged by
+   ``check_drift`` (warn records, error raises ``DriftError``).
+
+Exit 0 = pass. Usage: python tools/check_health.py
+Wired into the quick verification tier via tests/test_health.py.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(_HERE))
+sys.path.insert(0, _HERE)
+
+import numpy as np  # noqa: E402
+
+REQUIRED_FAMILIES = (
+    "lgbmtpu_health_collective_calls_total",
+    "lgbmtpu_health_collective_bytes_total",
+    "lgbmtpu_health_collective_seconds_total",
+    "lgbmtpu_health_straggler_skew",
+    "lgbmtpu_health_drift_checks_total",
+    "lgbmtpu_health_drift_mismatch_total",
+    "lgbmtpu_health_nonfinite_total",
+)
+
+
+def _fail(msg: str) -> int:
+    print(f"CHECK-HEALTH FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.export import render_openmetrics
+    from lightgbm_tpu.obs.health import (DriftError, NonFiniteError,
+                                         global_health)
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.obs.trace import global_tracer
+    from check_metrics_endpoint import validate_exposition
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(1024, 8)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.3).astype(np.float32)
+
+    # --- 1. disabled path emits nothing ------------------------------
+    global_health.reset()
+    lgb.train({"objective": "binary", "verbosity": -1, "num_leaves": 7},
+              lgb.Dataset(X, label=y), num_boost_round=2)
+    if global_health.summary():
+        return _fail(f"disabled run left a non-empty health summary: "
+                     f"{global_health.summary()}")
+    if "lgbmtpu_health_" in render_openmetrics():
+        return _fail("disabled run leaked lgbmtpu_health_* families "
+                     "into the OpenMetrics document")
+    print("# disabled path emits nothing: OK")
+
+    # --- 2. armed mesh run surfaces every family ---------------------
+    global_metrics.enable()  # arms tracer/watermarks/xla/health
+    try:
+        bst = lgb.Booster(
+            {"objective": "binary", "tree_learner": "voting", "top_k": 3,
+             "tpu_num_shards": 8, "num_leaves": 7, "tpu_wave_max": 0,
+             "tpu_health": "warn", "min_data_in_leaf": 5,
+             "verbosity": -1}, lgb.Dataset(X, label=y))
+        for _ in range(2):
+            bst.update()
+        mesh = bst._gbdt.mesh
+        global_health.probe_collectives(mesh)
+        global_health.straggler_probe()
+        # drift families render once a check ran (mismatch stays 0 on
+        # a clean replicated array)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        clean = jax.device_put(np.arange(16, dtype=np.float32),
+                               NamedSharding(mesh, P()))
+        global_health.check_drift(mesh, {"probe": clean}, mode="warn")
+        # the nonfinite family renders once any count exists; seed the
+        # zero-count kinds so the family is present on a healthy run
+        global_health.nonfinite.setdefault("grad", 0)
+        text = render_openmetrics()
+    finally:
+        global_metrics.disable()
+        global_tracer.disable()
+        global_health.disable()
+        from lightgbm_tpu.obs.memory import global_watermarks
+        from lightgbm_tpu.obs.xla import global_xla
+        global_watermarks.disable()
+        global_xla.disable()
+
+    errors, families = validate_exposition(text)
+    if errors:
+        return _fail("invalid exposition with health families: "
+                     + "; ".join(errors[:5]))
+    missing = [f for f in REQUIRED_FAMILIES if f not in families]
+    if missing:
+        return _fail(f"health families missing from /metrics: {missing}")
+    print(f"# health families present ({len(families)} total families, "
+          f"exposition valid): OK")
+
+    # --- 3. NaN sentinel on a poisoned-label fixture -----------------
+    y_poison = X[:, 0].astype(np.float64).copy()
+    y_poison[7] = np.nan
+    global_health.reset()
+    lgb.train({"objective": "regression", "verbosity": -1,
+               "tpu_health": "warn", "num_leaves": 7},
+              lgb.Dataset(X, label=y_poison), num_boost_round=1)
+    if not global_health.nonfinite.get("grad"):
+        return _fail("warn-mode NaN sentinel did not record poisoned "
+                     f"gradients: {global_health.nonfinite}")
+    if global_health.last_nonfinite is None or \
+            global_health.last_nonfinite.get("iteration") != 0:
+        return _fail("NaN sentinel did not fire within the first "
+                     f"iteration: {global_health.last_nonfinite}")
+    try:
+        lgb.train({"objective": "regression", "verbosity": -1,
+                   "tpu_health": "error", "num_leaves": 7},
+                  lgb.Dataset(X, label=y_poison), num_boost_round=3)
+        return _fail("error-mode NaN sentinel did not raise")
+    except NonFiniteError:
+        pass
+    print("# NaN sentinel fires on poisoned labels (warn records, "
+          "error raises): OK")
+
+    # --- 4. drift sentinel on injected divergence --------------------
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from lightgbm_tpu.parallel import mesh as mesh_lib
+    mesh = mesh_lib.get_mesh(8)
+    host = np.arange(64, dtype=np.float32)
+    copies = []
+    for i, dev in enumerate(mesh.devices.flat):
+        h = host.copy()
+        if i == 5:
+            h[3] += 1.0  # the diverged replica
+        copies.append(jax.device_put(h, dev))
+    diverged = jax.make_array_from_single_device_arrays(
+        host.shape, NamedSharding(mesh, P()), copies)
+    global_health.reset()
+    mm = global_health.check_drift(mesh, {"state": diverged}, mode="warn")
+    if not mm or mm[0]["shards"] != [5]:
+        return _fail(f"injected divergence not attributed to shard 5: "
+                     f"{mm}")
+    try:
+        global_health.check_drift(mesh, {"state": diverged}, mode="error")
+        return _fail("error-mode drift check did not raise DriftError")
+    except DriftError:
+        pass
+    print("# drift sentinel flags injected divergence (shard 5): OK")
+
+    print("check_health OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,7 +2,7 @@
 """Perf-regression gate (ROADMAP item 4: convert "should be fast" into
 driver-visible proof).
 
-Five checks, all against the recorded floor in tools/perf_floor.json:
+Six checks, all against the recorded floor in tools/perf_floor.json:
 
 1. **Histogram traffic model** — recomputes the static per-iteration
    HBM byte model (learner.hist_traffic_model) for the recorded
@@ -49,6 +49,15 @@ Five checks, all against the recorded floor in tools/perf_floor.json:
    broken), and the memory model's operand/slab components must cover
    the executable's argument/output buffers. Independent, silicon-free
    proof; skips gracefully where the backend exposes no cost analysis.
+
+6. **Comms health** — over the obs/health summaries bench.py folds
+   into its JSON line (`health` field): the latest record's per-phase
+   straggler skew (above an absolute-noise floor) must stay under the
+   recorded ceiling, and the estimated collective time share (runtime
+   collective bytes x the timed mesh probe's per-byte rate, over
+   measured train seconds) must not make iterations comms-bound.
+   No mesh run recorded => the check reports itself skipped — the
+   same graceful-skip pattern as the other obs pillars.
 
 Exit 0 = gate passed; exit 1 = regression, with one line per failure.
 Wired into the quick verification tier via tests/test_perf_gate.py.
@@ -329,6 +338,60 @@ def check_xla_cost_model(floor, failures):
               f"{out_b / 1e6:.3f} MB")
 
 
+def check_health_summaries(floor, failures, lines):
+    """Comms-health gate (check 6) over the obs/health summaries bench
+    folds into its JSON line — the same pattern as the other obs
+    pillars: the latest record carrying a `health` dict is held to the
+    recorded straggler-skew ceiling (phases above the absolute-noise
+    floor only) and to the collective-time-share ceiling (estimated
+    collective seconds / measured train seconds). Runs without a mesh
+    record nothing -> the check reports itself skipped."""
+    cfg = floor.get("health")
+    if not cfg:
+        print("# no health floor recorded; health check skipped")
+        return
+    with_health = [(tag, rec) for tag, rec in lines
+                   if isinstance(rec.get("health"), dict)]
+    if not with_health:
+        print("# no health summaries recorded (no mesh run); "
+              "health check skipped")
+        return
+    tag, rec = with_health[-1]
+    hs = rec["health"]
+    max_skew = float(cfg.get("max_straggler_skew", 4.0))
+    min_abs = float(cfg.get("min_abs_straggler_seconds", 0.05))
+    strag = hs.get("straggler") or {}
+    checked = 0
+    for phase, ph in (strag.get("phases") or {}).items():
+        if not isinstance(ph, dict):
+            continue
+        # the noise floor applies to the skew DENOMINATOR: a phase the
+        # median host barely ran (host-local work like binning on
+        # process 0) has a meaningless max/median ratio, not a straggler
+        if float(ph.get("median_s", 0.0)) < min_abs:
+            continue
+        checked += 1
+        skew = float(ph.get("skew", 1.0))
+        if skew > max_skew:
+            failures.append(
+                f"{tag}: straggler skew {skew:.2f}x on phase '{phase}' "
+                f"(worst shard {ph.get('worst')}) exceeds the "
+                f"{max_skew}x ceiling")
+    est = hs.get("collectives_est") or {}
+    share = est.get("time_share")
+    max_share = float(cfg.get("max_collective_time_share", 0.6))
+    if isinstance(share, (int, float)) and share > max_share:
+        failures.append(
+            f"{tag}: estimated collective time share {share:.2%} "
+            f"exceeds the {max_share:.0%} ceiling — comms-bound "
+            f"iterations (est {est.get('est_seconds')}s of "
+            f"{est.get('train_seconds')}s)")
+    print(f"# health[{tag}]: {checked} straggler phase(s) checked"
+          + (f", collective share {share:.2%}"
+             if isinstance(share, (int, float)) else
+             ", no collective share estimate"))
+
+
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
@@ -382,6 +445,7 @@ def main(argv=None) -> int:
     check_xla_cost_model(floor, failures)
     check_bench_trajectory(floor, failures, lines, candidate_rec)
     check_phase_trajectory(floor, failures, lines)
+    check_health_summaries(floor, failures, lines)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")
