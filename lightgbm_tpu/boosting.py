@@ -30,6 +30,7 @@ from .learner import grow_tree, grow_tree_waved, replay_tree
 from .obs import health as obs_health
 from .obs import xla as obs_xla
 from .obs.export import global_flusher
+from .resilience import faults as faults_mod
 from .obs.metrics import global_metrics
 from .obs.trace import global_tracer
 from .timer import global_timer  # noqa: F401  (compat facade re-export)
@@ -1061,6 +1062,12 @@ class GBDT:
         metrics record; disabled mode is a single attribute check."""
         if global_flusher.armed:  # LGBM_TPU_METRICS_FILE textfile egress
             global_flusher.maybe_flush()
+        if faults_mod.global_faults.armed:
+            # deterministic fault plan (resilience/faults.py): the
+            # slow-shard fault injects its straggler delay at the
+            # iteration lifecycle so skew probes see it from ANY entry
+            # point (engine / capi / sklearn), not just engine.train
+            faults_mod.global_faults.maybe_slow_iteration()
         if not global_metrics.enabled:
             if not self._health_armed:
                 return self._train_one_iter_impl(custom_grad, custom_hess)

@@ -183,6 +183,9 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_preflight": ["preflight", "memory_preflight"],
     "tpu_health": ["health", "training_health"],
     "tpu_health_every": ["health_every", "health_check_every"],
+    # resilience knobs (resilience/ subsystem)
+    "tpu_checkpoint_every": ["checkpoint_every", "checkpoint_freq"],
+    "tpu_checkpoint_path": ["checkpoint_path", "checkpoint_file"],
     # serving knobs (serve/ subsystem)
     "serve_max_batch_rows": ["serve_max_batch"],
     "serve_max_wait_ms": ["serve_max_wait"],
@@ -190,6 +193,12 @@ _ALIASES: Dict[str, List[str]] = {
     "serve_cache_bytes": ["serve_pack_budget_bytes"],
     "serve_request_rows": [],
     "serve_metrics_port": ["metrics_port"],
+    "serve_deadline_ms": ["serve_deadline"],
+    "serve_max_queue_rows": ["serve_queue_rows"],
+    "serve_retry_max": ["serve_retries"],
+    "serve_retry_backoff_ms": [],
+    "serve_breaker_threshold": ["serve_breaker_failures"],
+    "serve_breaker_reset_s": ["serve_breaker_reset"],
 }
 
 _ALIAS_TO_CANONICAL: Dict[str, str] = {}
@@ -566,6 +575,21 @@ class Config:
     # straggler probe): every N iterations. 1 = every iteration; larger
     # values amortize the tiny host sync the sentinel read costs.
     tpu_health_every: int = 1
+    # fault-tolerant training (resilience/checkpoint.py). With
+    # tpu_checkpoint_path set, engine.train snapshots FULL boosting
+    # state (trees + scores + sampling masks + RNG streams + DART drop
+    # bookkeeping + best-iteration) atomically every
+    # tpu_checkpoint_every iterations, installs a SIGTERM handler that
+    # finishes the in-flight iteration, snapshots, and exits with code
+    # 75 (EXIT_PREEMPTED), and RESUMES from an existing checkpoint at
+    # the same path — train-N-straight == train-k/kill/resume/train-
+    # (N-k) bit-identically (tests/test_resilience.py). Checkpoints
+    # carry a SHA-256 digest footer; a corrupt/truncated file raises
+    # CorruptCheckpointError instead of resuming on torn state.
+    # tpu_checkpoint_every=0 still snapshots on SIGTERM, just never
+    # periodically.
+    tpu_checkpoint_every: int = 0
+    tpu_checkpoint_path: str = ""
     # serving (serve/ async model server; task=serve and the in-process
     # API). Micro-batching: requests coalesce until serve_max_batch_rows
     # rows are pending or the OLDEST pending request has waited
@@ -584,6 +608,26 @@ class Config:
     serve_cache_bytes: int = 1 << 30
     serve_request_rows: int = 0
     serve_metrics_port: int = -1
+    # serving graceful degradation (resilience/degrade.py). Per-request
+    # deadline: a request older than serve_deadline_ms fails fast with
+    # a structured DeadlineExceeded instead of occupying the batcher
+    # (0 = no deadline). Bounded admission: when more than
+    # serve_max_queue_rows rows are already queued/in flight, new
+    # arrivals are shed with ServerOverloaded carrying retry-after
+    # semantics (0 = unbounded). Transient registry pack/compile
+    # failures retry with exponential backoff (serve_retry_max
+    # attempts, base serve_retry_backoff_ms). A model whose dispatches
+    # keep faulting trips a per-model circuit breaker after
+    # serve_breaker_threshold consecutive failures (0 = breaker off);
+    # the breaker fails fast for serve_breaker_reset_s seconds, then
+    # half-opens one probe. All events are counted in obs.metrics and
+    # exported as lgbmtpu_resilience_* OpenMetrics families.
+    serve_deadline_ms: float = 0.0
+    serve_max_queue_rows: int = 0
+    serve_retry_max: int = 2
+    serve_retry_backoff_ms: float = 10.0
+    serve_breaker_threshold: int = 5
+    serve_breaker_reset_s: float = 30.0
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)

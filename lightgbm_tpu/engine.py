@@ -4,6 +4,7 @@ train, :626 cv)."""
 from __future__ import annotations
 
 import copy
+import signal
 from typing import Any, Callable, Dict, List, Optional, Union
 
 import numpy as np
@@ -11,6 +12,9 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset, LightGBMError
 from .config import Config
+from .resilience import checkpoint as ckpt_mod
+from .resilience import faults as faults_mod
+from .resilience.errors import EXIT_PREEMPTED
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -62,53 +66,174 @@ def train(params: Dict[str, Any], train_set: Dataset,
     from .obs.metrics import global_metrics
     restore_telemetry = _scoped_telemetry_enable(callbacks)
 
-    booster.best_iteration = -1
-    try:
-        for i in range(num_boost_round):
-            for cb in callbacks_before:
-                cb(callback_mod.CallbackEnv(
-                    model=booster, params=params, iteration=i,
-                    begin_iteration=0, end_iteration=num_boost_round,
-                    evaluation_result_list=None))
-            should_stop = booster.update()
-            telemetry = (global_metrics.snapshot()
-                         if global_metrics.enabled else None)
+    # ------------------------------------------------------------------
+    # fault-tolerant training (resilience/checkpoint.py): resume from an
+    # existing checkpoint at tpu_checkpoint_path, snapshot every
+    # tpu_checkpoint_every iterations, and turn SIGTERM into
+    # finish-iteration -> snapshot -> exit(EXIT_PREEMPTED)
+    ckpt_path = str(cfg.tpu_checkpoint_path or "")
+    ckpt_every = int(cfg.tpu_checkpoint_every)
+    booster.best_iteration = -1  # before restore: a resumed checkpoint
+    # re-installs the best-iteration/score it recorded
+    start_iteration = 0
+    if ckpt_path:
+        state = ckpt_mod.try_load(ckpt_path)  # corrupt file -> raises
+        if state is not None:
+            if init_model is not None:
+                from . import log
+                log.warning("tpu_checkpoint_path: checkpoint found; "
+                            "its state supersedes init_model")
+            start_iteration = ckpt_mod.restore_booster(booster, state)
+            if state.get("finished"):
+                # the checkpointed run had already DECIDED to stop
+                # (early stopping / no splittable leaves): resuming
+                # must not train the remaining rounds
+                start_iteration = num_boost_round
+            from . import log
+            log.info(f"resumed from checkpoint {ckpt_path} at iteration "
+                     f"{start_iteration}/{num_boost_round}")
+    preempt = {"flag": False}
+    prev_sigterm = _install_sigterm(preempt) if ckpt_path else None
 
-            evaluation_result_list = []
-            needs_eval = any(getattr(cb, "needs_eval", False)
-                             for cb in callbacks_after)
-            if (valid_sets or cfg.is_provide_training_metric) and \
-                    (needs_eval or (cfg.metric_freq > 0
-                                    and (i + 1) % cfg.metric_freq == 0)):
-                if is_valid_contain_train or cfg.is_provide_training_metric:
-                    evaluation_result_list.extend(booster.eval_train(feval))
-                evaluation_result_list.extend(booster.eval_valid(feval))
-                if evaluation_result_list:
-                    # eval-loss anomaly detector (obs/health.py): one
-                    # attribute check when health isn't armed
-                    from .obs.health import global_health
-                    if global_health.enabled:
-                        global_health.note_evals(i, evaluation_result_list)
+    interrupted = False
+    try:
+        for i in range(start_iteration, num_boost_round):
+            faults = faults_mod.global_faults
+            if faults.armed:
+                faults.maybe_poison_labels(booster, i)
             try:
-                for cb in callbacks_after:
+                for cb in callbacks_before:
                     cb(callback_mod.CallbackEnv(
                         model=booster, params=params, iteration=i,
                         begin_iteration=0, end_iteration=num_boost_round,
-                        evaluation_result_list=evaluation_result_list,
-                        telemetry=telemetry))
-            except callback_mod.EarlyStopException as e:
-                booster.best_iteration = e.best_iteration + 1
-                for item in e.best_score:
-                    booster.best_score.setdefault(
-                        item[0], {})[item[1]] = item[2]
+                        evaluation_result_list=None))
+                should_stop = booster.update()
+                telemetry = (global_metrics.snapshot()
+                             if global_metrics.enabled else None)
+
+                evaluation_result_list = []
+                needs_eval = any(getattr(cb, "needs_eval", False)
+                                 for cb in callbacks_after)
+                if (valid_sets or cfg.is_provide_training_metric) and \
+                        (needs_eval or (cfg.metric_freq > 0
+                                        and (i + 1) % cfg.metric_freq == 0)):
+                    if is_valid_contain_train or \
+                            cfg.is_provide_training_metric:
+                        evaluation_result_list.extend(
+                            booster.eval_train(feval))
+                    evaluation_result_list.extend(booster.eval_valid(feval))
+                    if evaluation_result_list:
+                        # eval-loss anomaly detector (obs/health.py): one
+                        # attribute check when health isn't armed
+                        from .obs.health import global_health
+                        if global_health.enabled:
+                            global_health.note_evals(
+                                i, evaluation_result_list)
+                try:
+                    for cb in callbacks_after:
+                        cb(callback_mod.CallbackEnv(
+                            model=booster, params=params, iteration=i,
+                            begin_iteration=0,
+                            end_iteration=num_boost_round,
+                            evaluation_result_list=evaluation_result_list,
+                            telemetry=telemetry))
+                except callback_mod.EarlyStopException as e:
+                    booster.best_iteration = e.best_iteration + 1
+                    for item in e.best_score:
+                        booster.best_score.setdefault(
+                            item[0], {})[item[1]] = item[2]
+                    break
+            except (KeyboardInterrupt, SystemExit) as exc:
+                # interrupt safety: finalize and hand back the
+                # best-so-far booster (trees are only appended at
+                # iteration granularity, so the model is consistent)
+                # instead of propagating with a half-updated booster
+                interrupted = True
+                from . import log
+                log.warning(
+                    f"training interrupted at iteration {i} "
+                    f"({type(exc).__name__}); returning the booster "
+                    f"with {booster.current_iteration()} completed "
+                    "iterations")
                 break
+
+            # -- iteration boundary: durable snapshot / preemption exit
+            if ckpt_path:
+                if faults.armed and faults.kill_now(i):
+                    preempt["flag"] = True  # injected preemption
+                periodic = ckpt_every > 0 and (i + 1) % ckpt_every == 0
+                if preempt["flag"] or periodic:
+                    # finished=should_stop: a snapshot taken on the
+                    # iteration that decided to stop (no splittable
+                    # leaves) must make a resume return immediately,
+                    # not train rounds the straight run never ran
+                    ckpt_mod.save_checkpoint(booster, ckpt_path,
+                                             num_boost_round,
+                                             finished=should_stop)
+                if preempt["flag"]:
+                    from . import log
+                    log.warning(
+                        f"preempted: snapshot at iteration {i + 1} "
+                        f"written to {ckpt_path}; exiting with code "
+                        f"{EXIT_PREEMPTED}")
+                    _flush_obs_egress()
+                    raise SystemExit(EXIT_PREEMPTED)
             if should_stop:
                 break
+        # a SIGTERM that landed during an iteration whose callbacks
+        # raised EarlyStopException breaks out ABOVE the boundary
+        # block (the should_stop case reaches it and snapshots
+        # finished=True there): still honor the preemption contract
+        # (snapshot + exit 75). The snapshot is marked finished — the
+        # run already decided to stop, so the supervisor's re-run
+        # returns immediately with the recorded best iteration instead
+        # of training the remaining rounds.
+        if ckpt_path and preempt["flag"] and not interrupted:
+            ckpt_mod.save_checkpoint(booster, ckpt_path,
+                                     num_boost_round, finished=True)
+            _flush_obs_egress()
+            raise SystemExit(EXIT_PREEMPTED)
     finally:
+        if prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_sigterm)
+            except (ValueError, OSError):
+                pass
         restore_telemetry()
+    if interrupted:
+        _flush_obs_egress()
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
     return booster
+
+
+def _install_sigterm(preempt: Dict[str, bool]):
+    """SIGTERM -> request a graceful preemption: the training loop
+    finishes the in-flight iteration, snapshots, and exits with
+    EXIT_PREEMPTED. Returns the previous handler (to restore), or None
+    when handlers cannot be installed here (non-main thread)."""
+    def _on_sigterm(signum, frame):
+        preempt["flag"] = True
+
+    try:
+        return signal.signal(signal.SIGTERM, _on_sigterm)
+    except ValueError:
+        return None
+
+
+def _flush_obs_egress() -> None:
+    """Push pending observability out before an abnormal return: the
+    OpenMetrics textfile (if armed) and the Chrome trace (if the tracer
+    was given a path) must reflect the run that just died."""
+    try:
+        from .obs.export import global_flusher
+        global_flusher.maybe_flush(force=True)
+        from .obs.trace import global_tracer
+        if global_tracer.enabled and getattr(global_tracer, "trace_path",
+                                             None):
+            global_tracer.export_chrome(global_tracer.trace_path)
+    except Exception:
+        pass  # telemetry egress must never mask the real outcome
 
 
 def _scoped_telemetry_enable(callbacks) -> Callable[[], None]:

@@ -15,6 +15,7 @@ from typing import List, Optional
 import numpy as np
 
 from .config import Config
+from .resilience.errors import CorruptModelError
 from .tree import Tree
 
 
@@ -235,8 +236,31 @@ def loaded_model_to_string(model: LoadedModel, num_iteration: int = -1,
 
 
 def load_model_from_string(text: str) -> LoadedModel:
+    """Parse the reference text format into a LoadedModel.
+
+    Structural validation (resilience satellite): a truncated or
+    garbage model raises a structured ``CorruptModelError`` naming the
+    byte offset where the content stopped making sense, instead of
+    silently producing a partial ensemble or a bare parse exception —
+    the failure modes of a half-written model file or a torn download.
+    Checks: the ``tree`` header magic, per-tree block parse errors, the
+    ``end of trees`` terminator, and the header's declared
+    ``tree_sizes`` count against the trees actually parsed."""
     model = LoadedModel()
+    if not text.lstrip().startswith("tree"):
+        raise CorruptModelError(
+            "not a LightGBM model: missing 'tree' header magic",
+            offset=0)
     lines = text.split("\n")
+
+    def _offset(line_no: int) -> int:
+        """Byte offset of the start of line `line_no` — computed only
+        on the error paths, so a healthy load (the serve registry's
+        hot path) never pays a per-line encode pass."""
+        return len("\n".join(lines[:line_no]).encode()) + \
+            (1 if line_no > 0 else 0)
+
+    declared_trees: Optional[int] = None
     i = 0
     # header
     while i < len(lines):
@@ -261,27 +285,58 @@ def load_model_from_string(text: str) -> LoadedModel:
                 model.feature_names = value.split()
             elif key == "feature_infos":
                 model.feature_infos = value.split()
+            elif key == "tree_sizes":
+                declared_trees = len(value.split())
         elif line == "average_output":
             model.average_output = True
 
+    def parse_block(block_lines: List[str], start_line: int) -> None:
+        try:
+            model.trees.append(Tree.from_string("\n".join(block_lines)))
+        except Exception as exc:
+            raise CorruptModelError(
+                f"tree block {len(model.trees)} failed to parse "
+                f"({exc!r}) — truncated or corrupted model",
+                offset=_offset(start_line))
+
     # tree blocks
     block: List[str] = []
+    block_start = i
+    saw_end = False
     while i < len(lines):
         line = lines[i]
         i += 1
         stripped = line.strip()
         if stripped.startswith("Tree=") and block:
-            model.trees.append(Tree.from_string("\n".join(block)))
+            parse_block(block, block_start)
             block = [stripped]
+            block_start = i - 1
         elif stripped == "end of trees":
             if block:
-                model.trees.append(Tree.from_string("\n".join(block)))
+                parse_block(block, block_start)
                 block = []
+            saw_end = True
             break
         elif stripped:
+            if not block:
+                block_start = i - 1
             block.append(stripped)
     if block:
-        model.trees.append(Tree.from_string("\n".join(block)))
+        parse_block(block, block_start)
+    if not saw_end:
+        # no terminator is a truncation, with or without parsed trees:
+        # a file torn mid-ensemble may carry an incomplete trailing
+        # block, and one torn in the HEADER (before tree_sizes) would
+        # otherwise load as a 0-tree model that silently serves
+        # constants — refuse both rather than serve a partial model
+        raise CorruptModelError(
+            "model truncated: 'end of trees' terminator missing",
+            offset=_offset(min(i, len(lines))))
+    if declared_trees is not None and len(model.trees) != declared_trees:
+        raise CorruptModelError(
+            f"model declares tree_sizes for {declared_trees} trees but "
+            f"{len(model.trees)} parsed — truncated mid-ensemble",
+            offset=_offset(min(i, len(lines))))
 
     # parameters block
     in_params = False

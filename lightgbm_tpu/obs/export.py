@@ -185,6 +185,18 @@ def render_openmetrics(registry=None,
                    help_text="analytic per-iteration histogram HBM "
                              "traffic (learner.hist_traffic_model)")
 
+    # checkpoint accounting (resilience/checkpoint.py; the snapshot
+    # COUNT rides the generic resilience/* counters above)
+    rc = meta.get("resilience_checkpoint")
+    if isinstance(rc, dict) and "seconds_total" in rc:
+        doc.sample("lgbmtpu_resilience_checkpoint_seconds_total",
+                   "counter", rc["seconds_total"],
+                   help_text="wall time spent writing training "
+                             "checkpoints (atomic snapshot + fsync "
+                             "path, resilience/checkpoint.py)")
+        doc.sample("lgbmtpu_resilience_checkpoint_last_iteration",
+                   "gauge", rc.get("last_iteration", -1))
+
     # XLA introspection (obs/xla.py; populated while enabled)
     from .xla import global_xla
     xs = global_xla.summary()

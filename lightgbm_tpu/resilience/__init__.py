@@ -1,0 +1,30 @@
+"""Fault tolerance for training and serving.
+
+- ``checkpoint`` — atomic full-state snapshots at iteration boundaries
+  + bit-identical resume (``tpu_checkpoint_every`` /
+  ``tpu_checkpoint_path``; SIGTERM-driven preemption snapshots exiting
+  with ``EXIT_PREEMPTED``).
+- ``faults`` — the deterministic fault-injection plan the tests and
+  ``tools/check_resilience.py`` drive the recovery paths with.
+- ``degrade`` — serving-side graceful degradation (per-model circuit
+  breaker, backoff schedules) used by ``serve/server.py`` together
+  with per-request deadlines and bounded admission.
+- ``errors`` — the structured exception taxonomy
+  (``CorruptModelError`` and friends).
+"""
+
+from .errors import (EXIT_PREEMPTED, CircuitOpenError,
+                     CorruptCheckpointError, CorruptModelError,
+                     DeadlineExceeded, ResumeMismatchError,
+                     ServerOverloaded, TransientServeError)
+from .faults import FaultPlan, global_faults, install as install_faults
+from .checkpoint import (load_checkpoint, restore_booster,
+                         save_checkpoint)
+
+__all__ = [
+    "EXIT_PREEMPTED", "CircuitOpenError", "CorruptCheckpointError",
+    "CorruptModelError", "DeadlineExceeded", "ResumeMismatchError",
+    "ServerOverloaded", "TransientServeError", "FaultPlan",
+    "global_faults", "install_faults", "load_checkpoint",
+    "restore_booster", "save_checkpoint",
+]

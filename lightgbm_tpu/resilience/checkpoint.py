@@ -1,0 +1,390 @@
+"""Atomic checkpoint / resume of full boosting state.
+
+``init_model`` continuation carries only the trees; everything else a
+resumed run needs for *bit-identical* continuation — the iteration
+counter, the live bagging mask, the evolving host RNG streams
+(feature sampling, DART drop selection), the exact f32 score buffers,
+DART's drop-history device buffers and weight bookkeeping, objective
+init scores and evolving device state, best-iteration/eval results —
+is rebuilt approximately or lost. This module snapshots ALL of it at an
+iteration boundary, so
+
+    train N iterations straight
+    == train k, get killed, resume, train N-k
+
+holds to the last bit of ``model_to_string()`` (asserted across the
+fixture matrix by tests/test_resilience.py: plain, bagging, GOSS,
+DART, linear-tree, quantized, 2-shard mesh).
+
+Container format (version 1)::
+
+    LGBMTPU-CKPT-v1\\n          magic
+    <pickle payload>            numpy-only state dict (no jax arrays)
+    \\n#LGBMTPU-CKPT-SHA256:<64 hex>\\n   digest footer over the payload
+
+Writes are atomic (tmp file + ``os.replace``), so a preemption during
+the write leaves the previous checkpoint intact; loads verify the
+digest footer before unpickling and raise ``CorruptCheckpointError``
+(naming the corrupt byte span) on any mismatch or truncation.
+
+Known scope limit: user callback CLOSURES are not serializable, so the
+``early_stopping`` callback's internal counters (rounds-without-
+improvement, its own best scores) restart at the resume point — the
+bit-identical contract is stated for fixed-round training. A run that
+already STOPPED early checkpoints its final ``best_iteration``/
+``best_score`` and a resume returns immediately, but a kill mid-run
+with early stopping may stop at a different round than the
+uninterrupted run would have.
+
+Sharded state restores through the target's *current* sharding
+(``jax.device_put(host, like.sharding)``): a resume on a resized mesh
+re-bins and re-shards through the normal setup path and the restored
+row state follows it. When ``tpu_health`` is armed on a multi-device
+mesh, the restored replicated score state is digest-compared across
+shards before the first resumed iteration contributes (obs/health.py
+drift sentinel) — a half-restored replica fails fast.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .errors import CorruptCheckpointError, ResumeMismatchError
+
+MAGIC = b"LGBMTPU-CKPT-v1\n"
+_FOOTER_TAG = b"\n#LGBMTPU-CKPT-SHA256:"
+_FOOTER_LEN = len(_FOOTER_TAG) + 64 + 1  # tag + hex digest + newline
+CHECKPOINT_VERSION = 1
+
+# always-on checkpoint accounting (snapshot count / seconds) — feeds
+# obs meta -> bench JSON -> perf-gate check 7's overhead ceiling
+_totals = {"checkpoints": 0, "seconds_total": 0.0, "last_iteration": -1}
+
+
+def checkpoint_totals() -> Dict[str, Any]:
+    return dict(_totals)
+
+
+def reset_totals() -> None:
+    _totals.update(checkpoints=0, seconds_total=0.0, last_iteration=-1)
+
+
+def _np_tree(obj):
+    """jax/numpy pytree -> plain numpy (host transfer), recursively."""
+    if isinstance(obj, dict):
+        return {k: _np_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_np_tree(v) for v in obj]
+        return out if isinstance(obj, list) else tuple(out)
+    if hasattr(obj, "shape") and hasattr(obj, "dtype"):
+        return np.asarray(obj)
+    return obj
+
+
+def _jnp_tree(obj):
+    """numpy pytree -> jax arrays (leaves only), recursively."""
+    import jax.numpy as jnp
+    if isinstance(obj, dict):
+        return {k: _jnp_tree(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        out = [_jnp_tree(v) for v in obj]
+        return out if isinstance(obj, list) else tuple(out)
+    if isinstance(obj, np.ndarray):
+        return jnp.asarray(obj)
+    return obj
+
+
+def _put_like(host: np.ndarray, like):
+    """Device-put `host` with the sharding of the freshly-built `like`
+    buffer — the restore path's answer to resized meshes: whatever
+    layout the rebuilt booster chose, the restored state follows."""
+    import jax
+    try:
+        return jax.device_put(np.asarray(host), like.sharding)
+    except Exception:
+        import jax.numpy as jnp
+        return jnp.asarray(host)
+
+
+# ---------------------------------------------------------------------------
+# capture
+def _fingerprint(gbdt) -> Dict[str, Any]:
+    return {
+        "boosting_type": gbdt.boosting_type,
+        "objective": getattr(gbdt.objective, "name", None),
+        "num_data": int(gbdt.num_data),
+        "num_features": int(gbdt.train_set.num_features),
+        "num_tree_per_iteration": int(gbdt.num_tree_per_iteration),
+        "num_leaves": int(gbdt.config.num_leaves),
+        "num_valid_sets": len(gbdt._valid_sets),
+    }
+
+
+def _capture_dart(gbdt) -> Dict[str, Any]:
+    st = {
+        "drop_rng": gbdt._drop_rng.get_state(),
+        "tree_weights": list(gbdt._tree_weights),
+        "sum_tree_weight": float(gbdt._sum_tree_weight),
+        "cur_shrinkage": float(gbdt._cur_shrinkage),
+        "num_init_iteration": int(gbdt._num_init_iteration),
+        "fast_disabled": bool(gbdt._dart_fast_disabled),
+        "dart_t": int(gbdt._dart_t),
+        "dart_base": int(gbdt._dart_base),
+        "unshrunk": gbdt._dart_unshrunk,
+        "factor_snapshot": getattr(gbdt, "_dart_factor_snapshot", None),
+        "buffers": None,
+    }
+    if gbdt._dart is not None:
+        st["buffers"] = {
+            "leaf_hist": np.asarray(gbdt._dart["leaf_hist"]),
+            "vhist": [np.asarray(v) for v in gbdt._dart["vhist"]],
+            "leaf_vals": np.asarray(gbdt._dart["leaf_vals"]),
+            "factors": np.asarray(gbdt._dart["factors"]),
+        }
+    return st
+
+
+def capture_state(booster, target_rounds: int = -1,
+                  finished: bool = False) -> Dict[str, Any]:
+    """Snapshot `booster`'s full boosting state as a numpy-only dict.
+    Must be called at an iteration boundary (engine.train's loop is the
+    only caller); materializes pending device records first, which is
+    the same math the uninterrupted run applies at save time.
+
+    ``model_str`` is stored ALONGSIDE the exact tree arrays on
+    purpose: restore never reads it, but it lets operators inspect a
+    checkpoint with any LightGBM tooling and gives a cross-version
+    escape hatch (``init_model`` continuation) if the pickled layout
+    ever changes. At production shape the [K, N] f32 score buffers
+    dominate the container, so the duplication is noise there.
+    ``target_rounds`` is likewise inspection metadata, NOT enforced on
+    restore — resuming with a different ``num_boost_round`` is
+    supported (extend or cut a run) and governed by the loop range."""
+    gbdt = booster._gbdt
+    if gbdt is None:
+        raise ValueError("checkpointing requires a training booster")
+    gbdt._materialize_records()
+    state: Dict[str, Any] = {
+        "version": CHECKPOINT_VERSION,
+        "fingerprint": _fingerprint(gbdt),
+        "iteration": int(gbdt.iter),
+        "target_rounds": int(target_rounds),
+        # True when the RUN decided it was done (early stopping / no
+        # splittable leaves) before the snapshot: a resume must return
+        # immediately instead of training the remaining rounds
+        "finished": bool(finished),
+        "model_str": booster.model_to_string(),
+        "trees": gbdt._host_models,       # exact float64 host arrays
+        "init_scores": list(gbdt.init_scores),
+        "init_done": bool(gbdt._init_done),
+        "shrinkage_rate": float(gbdt.shrinkage_rate),
+        "scores": np.asarray(gbdt.scores),
+        "sample_mask": np.asarray(gbdt._sample_mask),
+        "valid_scores": [np.asarray(v) for v in gbdt._valid_scores],
+        "feature_rng": gbdt._feature_rng.get_state(),
+        "rng": gbdt._rng.get_state(),
+        "cegb_used": np.asarray(gbdt._cegb_used).copy(),
+        "objective_state": _np_tree(
+            gbdt.objective.device_state(evolving_only=True)
+            if gbdt.objective is not None else None),
+        "best_iteration": int(booster.best_iteration),
+        "best_score": dict(booster.best_score),
+        "dart": (_capture_dart(gbdt)
+                 if gbdt.boosting_type == "dart" else None),
+    }
+    return state
+
+
+# ---------------------------------------------------------------------------
+# container I/O
+def write_checkpoint(state: Dict[str, Any], path: str) -> Dict[str, Any]:
+    """Serialize + atomically write `state`; returns totals meta."""
+    t0 = time.perf_counter()
+    payload = pickle.dumps(state, protocol=4)
+    digest = hashlib.sha256(payload).hexdigest().encode()
+    tmp = path + f".tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(MAGIC)
+        fh.write(payload)
+        fh.write(_FOOTER_TAG + digest + b"\n")
+        fh.flush()
+        os.fsync(fh.fileno())  # durable BEFORE the rename: a host
+        # crash right after replace must not leave torn pages behind
+        # the only checkpoint
+    os.replace(tmp, path)  # a reader never sees a torn checkpoint
+    try:  # make the rename itself durable (best-effort on odd FSes)
+        dfd = os.open(os.path.dirname(os.path.abspath(path)),
+                      os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+    except OSError:
+        pass
+    # fault plan: corrupt-a-byte runs AFTER the atomic rename, so the
+    # on-disk artifact is what the digest check must reject
+    from .faults import global_faults
+    if global_faults.armed:
+        global_faults.maybe_corrupt_checkpoint(path)
+    dt = time.perf_counter() - t0
+    _totals["checkpoints"] += 1
+    _totals["seconds_total"] += dt
+    _totals["last_iteration"] = int(state.get("iteration", -1))
+    from ..obs.metrics import global_metrics
+    global_metrics.inc_counter("resilience/checkpoints")
+    global_metrics.set_meta("resilience_checkpoint", checkpoint_totals())
+    return checkpoint_totals()
+
+
+def save_checkpoint(booster, path: str, target_rounds: int = -1,
+                    finished: bool = False) -> Dict[str, Any]:
+    return write_checkpoint(
+        capture_state(booster, target_rounds, finished=finished), path)
+
+
+def load_checkpoint(path: str) -> Dict[str, Any]:
+    """Read + digest-verify + unpickle a checkpoint container."""
+    with open(path, "rb") as fh:
+        data = fh.read()
+    if not data.startswith(MAGIC):
+        raise CorruptCheckpointError(
+            "not a lightgbm_tpu checkpoint (bad magic)", offset=0,
+            path=path)
+    if len(data) < len(MAGIC) + _FOOTER_LEN or \
+            not data[-_FOOTER_LEN:].startswith(_FOOTER_TAG):
+        raise CorruptCheckpointError(
+            "checkpoint truncated: digest footer missing",
+            offset=len(data), path=path)
+    footer = data[-_FOOTER_LEN:]
+    want = footer[len(_FOOTER_TAG):-1]
+    payload = data[len(MAGIC):-_FOOTER_LEN]
+    got = hashlib.sha256(payload).hexdigest().encode()
+    if got != want:
+        raise CorruptCheckpointError(
+            f"checkpoint digest mismatch over payload bytes "
+            f"{len(MAGIC)}..{len(MAGIC) + len(payload)}",
+            offset=len(MAGIC), path=path)
+    try:
+        state = pickle.loads(payload)
+    except Exception as exc:
+        raise CorruptCheckpointError(
+            f"checkpoint payload failed to deserialize: {exc!r}",
+            offset=len(MAGIC), path=path)
+    if state.get("version") != CHECKPOINT_VERSION:
+        raise CorruptCheckpointError(
+            f"unsupported checkpoint version {state.get('version')!r}",
+            offset=len(MAGIC), path=path)
+    return state
+
+
+# ---------------------------------------------------------------------------
+# restore
+def _restore_dart(gbdt, st: Dict[str, Any]) -> None:
+    import jax.numpy as jnp
+    gbdt._drop_rng.set_state(st["drop_rng"])
+    gbdt._tree_weights = list(st["tree_weights"])
+    gbdt._sum_tree_weight = float(st["sum_tree_weight"])
+    gbdt._cur_shrinkage = float(st["cur_shrinkage"])
+    gbdt._num_init_iteration = int(st["num_init_iteration"])
+    gbdt._dart_fast_disabled = bool(st["fast_disabled"])
+    gbdt._dart_t = int(st["dart_t"])
+    gbdt._dart_base = int(st["dart_base"])
+    gbdt._dart_unshrunk = list(st["unshrunk"])
+    if st.get("factor_snapshot") is not None:
+        gbdt._dart_factor_snapshot = np.asarray(st["factor_snapshot"])
+    gbdt._dart_fused = None
+    gbdt._dart = None
+    if st.get("buffers") is not None:
+        buf = st["buffers"]
+        gbdt._dart = {
+            "leaf_hist": jnp.asarray(buf["leaf_hist"]),
+            "vhist": [jnp.asarray(v) for v in buf["vhist"]],
+            "leaf_vals": jnp.asarray(buf["leaf_vals"]),
+            "factors": jnp.asarray(buf["factors"]),
+        }
+
+
+def restore_booster(booster, state: Dict[str, Any]) -> int:
+    """Install `state` into a freshly-constructed Booster (same params,
+    same train/valid data, possibly a different mesh size). Returns the
+    iteration to resume from."""
+    gbdt = booster._gbdt
+    if gbdt is None:
+        raise ValueError("resume requires a training booster")
+    fp_now, fp_ck = _fingerprint(gbdt), state["fingerprint"]
+    if fp_now != fp_ck:
+        diffs = {k: (fp_ck.get(k), fp_now.get(k)) for k in fp_ck
+                 if fp_ck.get(k) != fp_now.get(k)}
+        raise ResumeMismatchError(
+            f"checkpoint is incompatible with this run: {diffs} "
+            "(checkpoint value, current value)")
+
+    gbdt._host_models = list(state["trees"])
+    gbdt._device_records = []
+    gbdt._record_lrs = []
+    gbdt.iter = int(state["iteration"])
+    gbdt.init_scores = list(state["init_scores"])
+    gbdt._init_done = bool(state["init_done"])
+    gbdt.shrinkage_rate = float(state["shrinkage_rate"])
+    gbdt.scores = _put_like(state["scores"], gbdt.scores)
+    gbdt._sample_mask = _put_like(state["sample_mask"], gbdt._sample_mask)
+    gbdt._valid_scores = [
+        _put_like(v, gbdt._valid_scores[i])
+        for i, v in enumerate(state["valid_scores"])]
+    gbdt._feature_rng.set_state(state["feature_rng"])
+    gbdt._rng.set_state(state["rng"])
+    gbdt._cegb_used = np.asarray(state["cegb_used"]).copy()
+    if state.get("objective_state") is not None and \
+            gbdt.objective is not None:
+        gbdt.objective.swap_device_state(
+            _jnp_tree(state["objective_state"]))
+    if state.get("dart") is not None and gbdt.boosting_type == "dart":
+        _restore_dart(gbdt, state["dart"])
+    booster.best_iteration = int(state["best_iteration"])
+    booster.best_score = dict(state["best_score"])
+    gbdt._fused = None  # rebuild against the restored buffers
+
+    _validate_restored_replicas(gbdt)
+    from ..obs.metrics import global_metrics
+    global_metrics.inc_counter("resilience/resumes")
+    return gbdt.iter
+
+
+def _validate_restored_replicas(gbdt) -> None:
+    """On a multi-device mesh with tpu_health armed, digest-compare the
+    restored replicated score state across shards BEFORE the rejoined
+    replica contributes an iteration — a torn restore (one host read a
+    stale checkpoint) fails fast as a structured DriftError instead of
+    silently forking the model."""
+    mesh = getattr(gbdt, "_shard_mesh", None) or getattr(gbdt, "mesh",
+                                                         None)
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return
+    if not getattr(gbdt, "_health_armed", False):
+        return
+    from ..obs import health as obs_health
+    from ..parallel.mesh import is_replicated_on
+    import jax
+    arrays = {}
+    if isinstance(gbdt.scores, jax.Array) and \
+            is_replicated_on(mesh, gbdt.scores):
+        arrays["restored_scores"] = gbdt.scores
+    if arrays:
+        obs_health.global_health.check_drift(
+            mesh, arrays, mode=gbdt._health_mode,
+            where="checkpoint restore")
+
+
+def try_load(path: str) -> Optional[Dict[str, Any]]:
+    """Load the checkpoint at `path` if one exists; None when absent.
+    Corruption still raises — silently retraining over a torn
+    checkpoint is exactly the failure mode the digest exists for."""
+    if not path or not os.path.exists(path):
+        return None
+    return load_checkpoint(path)

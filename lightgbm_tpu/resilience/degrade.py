@@ -1,0 +1,124 @@
+"""Graceful-degradation primitives for the serving path.
+
+``CircuitBreaker`` is the classic three-state machine, per served
+model:
+
+- **closed** — requests flow; consecutive dispatch faults count up.
+- **open** — after ``threshold`` consecutive faults, requests fail
+  fast with ``CircuitOpenError`` (carrying a retry-after hint) for
+  ``reset_s`` seconds, so a model whose packs/compiles are broken
+  stops eating executor time that healthy tenants need.
+- **half-open** — after the timer, exactly ONE probe request is let
+  through; success closes the breaker, failure re-opens it for another
+  ``reset_s``.
+
+State transitions are counted into ``obs.metrics`` under
+``resilience/*`` (exported as ``lgbmtpu_resilience_*`` OpenMetrics
+families). Thread-safe: the server's event loop checks admission while
+the executor thread reports outcomes.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+from ..obs.metrics import global_metrics
+from .errors import CircuitOpenError
+
+CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+
+class CircuitBreaker:
+    def __init__(self, name: str, threshold: int = 5,
+                 reset_s: float = 30.0) -> None:
+        self.name = name
+        self.threshold = max(int(threshold), 1)
+        self.reset_s = max(float(reset_s), 1e-3)
+        self.state = CLOSED
+        self.consecutive_failures = 0
+        self._opened_at = 0.0
+        self._probe_in_flight = False
+        self._probe_started = 0.0
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    def admit(self) -> bool:
+        """Gate one request. Raises ``CircuitOpenError`` while open;
+        while half-open, admits a single probe and rejects the rest.
+        Returns True when THIS admission took the half-open probe slot
+        (the caller must pair it with record_success/record_failure or
+        release_probe), False for a plain closed-state admission."""
+        with self._lock:
+            if self.state == CLOSED:
+                return False
+            now = time.monotonic()
+            if self.state == OPEN:
+                remaining = self._opened_at + self.reset_s - now
+                if remaining > 0:
+                    global_metrics.inc_counter(
+                        "resilience/breaker_rejected")
+                    raise CircuitOpenError(
+                        f"circuit for model '{self.name}' is open "
+                        f"({self.consecutive_failures} consecutive "
+                        f"faults); retry in {remaining:.3f}s",
+                        retry_after_s=remaining)
+                self.state = HALF_OPEN
+                self._probe_in_flight = False
+                global_metrics.inc_counter(
+                    "resilience/breaker_half_open")
+            # half-open: one probe at a time. A probe that never
+            # reported back (died via deadline/cancellation/shed — not
+            # a model fault) releases its slot after reset_s, so an
+            # abandoned probe can never deny the model service forever.
+            if self._probe_in_flight and \
+                    now - self._probe_started < self.reset_s:
+                global_metrics.inc_counter("resilience/breaker_rejected")
+                raise CircuitOpenError(
+                    f"circuit for model '{self.name}' is half-open with "
+                    "a probe in flight; retry shortly",
+                    retry_after_s=self.reset_s / 10.0)
+            self._probe_in_flight = True
+            self._probe_started = now
+            return True
+
+    def release_probe(self) -> None:
+        """The in-flight request ended without a verdict on the model
+        (deadline expiry, cancellation, load shed): free the half-open
+        probe slot without changing breaker state."""
+        with self._lock:
+            self._probe_in_flight = False
+
+    def record_success(self) -> None:
+        with self._lock:
+            if self.state != CLOSED:
+                global_metrics.inc_counter("resilience/breaker_closed")
+            self.state = CLOSED
+            self.consecutive_failures = 0
+            self._probe_in_flight = False
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self.consecutive_failures += 1
+            self._probe_in_flight = False
+            if self.state == HALF_OPEN or (
+                    self.state == CLOSED
+                    and self.consecutive_failures >= self.threshold):
+                self.state = OPEN
+                self._opened_at = time.monotonic()
+                global_metrics.inc_counter("resilience/breaker_open")
+
+    @property
+    def is_open(self) -> bool:
+        with self._lock:
+            return self.state == OPEN
+
+
+def backoff_delays(max_retries: int, base_s: float,
+                   cap_s: float = 1.0) -> list:
+    """Exponential backoff schedule: [base, 2*base, 4*base, ...] capped.
+    Deterministic (no jitter) so the chaos validator's timings are
+    reproducible; a fleet-scale deployment would add jitter upstream."""
+    return [min(base_s * (2 ** i), cap_s)
+            for i in range(max(int(max_retries), 0))]

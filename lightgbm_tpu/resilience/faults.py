@@ -1,0 +1,225 @@
+"""Deterministic fault injection for the resilience paths.
+
+A recovery path that is never exercised is a recovery path that does
+not work. ``FaultPlan`` is a small, fully deterministic schedule of
+failures that the tests and ``tools/check_resilience.py`` drive through
+the REAL production code paths — no monkeypatched shortcuts:
+
+- ``kill_at_iter=k`` — engine.train treats the boundary after iteration
+  k exactly like a SIGTERM: finish the iteration, snapshot, exit with
+  ``EXIT_PREEMPTED``.
+- ``corrupt_checkpoint_byte=off`` — after a checkpoint lands on disk,
+  flip the byte at offset ``off`` of the payload (validates that the
+  digest footer rejects it on load).
+- ``poison_labels_at_iter=k`` — overwrite the first label with NaN
+  before iteration k trains (drives the obs/health NaN sentinel and the
+  interrupt-safety paths with a *realistic* data fault).
+- ``slow_iter_ms=m`` (optionally ``slow_shard=ordinal``) — sleep m ms at
+  every iteration boundary on the matching process (straggler shape for
+  the obs/health skew probes; all processes when ``slow_shard`` unset).
+- ``registry_load_failures=n`` — the first n ``ModelRegistry.load``
+  calls raise ``TransientServeError`` mid-load (after parsing, before
+  registration) — the transactional-registration regression fixture.
+- ``serve_predict_failures=n`` — the first n serve dispatches raise
+  ``TransientServeError`` before touching the model (drives the
+  retry/backoff path and, once retries exhaust, the circuit breaker).
+- ``serve_slow_ms=m`` — each serve dispatch sleeps m ms on the executor
+  (deterministic queue pressure for the deadline / load-shed tests).
+
+Plans parse from the ``LGBM_TPU_FAULTS`` env var (comma-separated
+``key=value``) or install programmatically via ``install(plan)``.
+Disabled cost: every hook starts with one truthiness check of
+``global_faults.armed``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .errors import TransientServeError
+
+_INT_KEYS = {"kill_at_iter", "corrupt_checkpoint_byte",
+             "poison_labels_at_iter", "registry_load_failures",
+             "serve_predict_failures", "slow_shard"}
+_FLOAT_KEYS = {"slow_iter_ms", "serve_slow_ms"}
+
+
+class FaultPlan:
+    """One deterministic fault schedule. All counters are internal to
+    the plan, so installing a fresh plan resets every fault."""
+
+    def __init__(self, **kwargs: Any) -> None:
+        self.kill_at_iter: Optional[int] = None
+        self.corrupt_checkpoint_byte: Optional[int] = None
+        self.poison_labels_at_iter: Optional[int] = None
+        self.slow_iter_ms: float = 0.0
+        self.slow_shard: Optional[int] = None
+        self.registry_load_failures: int = 0
+        self.serve_predict_failures: int = 0
+        self.serve_slow_ms: float = 0.0
+        for key, value in kwargs.items():
+            if not hasattr(self, key):
+                raise ValueError(f"unknown fault knob {key!r}")
+            setattr(self, key, value)
+        self._lock = threading.Lock()
+        self._fired: Dict[str, int] = {}
+
+    @classmethod
+    def from_spec(cls, spec: str) -> "FaultPlan":
+        """Parse ``"kill_at_iter=4,serve_slow_ms=20"``."""
+        kwargs: Dict[str, Any] = {}
+        for tok in str(spec).split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            if "=" not in tok:
+                raise ValueError(f"fault spec token {tok!r} is not "
+                                 "key=value")
+            key, value = tok.split("=", 1)
+            key = key.strip()
+            if key in _INT_KEYS:
+                kwargs[key] = int(value)
+            elif key in _FLOAT_KEYS:
+                kwargs[key] = float(value)
+            else:
+                raise ValueError(f"unknown fault knob {key!r}")
+        return cls(**kwargs)
+
+    @classmethod
+    def from_env(cls) -> Optional["FaultPlan"]:
+        spec = os.environ.get("LGBM_TPU_FAULTS", "")
+        return cls.from_spec(spec) if spec else None
+
+    # ------------------------------------------------------------------
+    def _note(self, kind: str) -> None:
+        with self._lock:
+            self._fired[kind] = self._fired.get(kind, 0) + 1
+        from ..obs.metrics import global_metrics
+        global_metrics.inc_counter("resilience/fault_injections")
+        global_metrics.inc_counter(f"resilience/fault_{kind}")
+
+    def fired(self, kind: str) -> int:
+        with self._lock:
+            return self._fired.get(kind, 0)
+
+    def _take(self, budget_attr: str) -> bool:
+        """Atomically consume one failure from a counted budget."""
+        with self._lock:
+            left = int(getattr(self, budget_attr))
+            if left <= 0:
+                return False
+            setattr(self, budget_attr, left - 1)
+        return True
+
+    # -- hooks (each called from exactly one production site) ----------
+    def kill_now(self, iteration: int) -> bool:
+        """True at the boundary after `iteration` when the plan says to
+        simulate preemption there (once)."""
+        if self.kill_at_iter is None or iteration != self.kill_at_iter:
+            return False
+        self.kill_at_iter = None  # one shot — the resumed run survives
+        self._note("kill")
+        return True
+
+    def maybe_corrupt_checkpoint(self, path: str) -> bool:
+        """Flip one payload byte of the checkpoint just written."""
+        off = self.corrupt_checkpoint_byte
+        if off is None:
+            return False
+        self.corrupt_checkpoint_byte = None
+        with open(path, "r+b") as fh:
+            fh.seek(int(off))
+            byte = fh.read(1)
+            fh.seek(int(off))
+            fh.write(bytes([(byte[0] ^ 0xFF) if byte else 0xFF]))
+        self._note("corrupt_checkpoint")
+        return True
+
+    def maybe_poison_labels(self, booster, iteration: int) -> bool:
+        """NaN-poison the first label before `iteration` trains."""
+        if self.poison_labels_at_iter is None or \
+                iteration != self.poison_labels_at_iter:
+            return False
+        self.poison_labels_at_iter = None
+        obj = getattr(getattr(booster, "_gbdt", None), "objective", None)
+        if obj is None or getattr(obj, "label", None) is None:
+            return False
+        import jax.numpy as jnp
+        obj.label = obj.label.at[0].set(jnp.nan)
+        if getattr(obj, "label_np", None) is not None:
+            obj.label_np = obj.label_np.copy()
+            obj.label_np[0] = float("nan")
+        self._note("poison_labels")
+        return True
+
+    def maybe_slow_iteration(self) -> None:
+        if self.slow_iter_ms <= 0:
+            return
+        if self.slow_shard is not None:
+            try:
+                import jax
+                if jax.process_index() != int(self.slow_shard):
+                    return
+            except Exception:
+                return
+        self._note("slow_iter")
+        time.sleep(self.slow_iter_ms / 1e3)
+
+    def check_registry_load(self, name: str) -> None:
+        if self._take("registry_load_failures"):
+            self._note("registry_load")
+            raise TransientServeError(
+                f"injected registry load failure for model {name!r}")
+
+    def check_serve_dispatch(self, name: str) -> None:
+        if self.serve_slow_ms > 0:
+            self._note("serve_slow")
+            time.sleep(self.serve_slow_ms / 1e3)
+        if self._take("serve_predict_failures"):
+            self._note("serve_predict")
+            raise TransientServeError(
+                f"injected predict failure for model {name!r}")
+
+
+class _NoFaults:
+    """The disabled plan: armed=False, every hook a no-op."""
+
+    armed = False
+
+    def kill_now(self, iteration: int) -> bool:
+        return False
+
+    def maybe_corrupt_checkpoint(self, path: str) -> bool:
+        return False
+
+    def maybe_poison_labels(self, booster, iteration: int) -> bool:
+        return False
+
+    def maybe_slow_iteration(self) -> None:
+        pass
+
+    def check_registry_load(self, name: str) -> None:
+        pass
+
+    def check_serve_dispatch(self, name: str) -> None:
+        pass
+
+
+FaultPlan.armed = True  # any real plan is armed
+_DISABLED = _NoFaults()
+global_faults = FaultPlan.from_env() or _DISABLED
+
+
+def install(plan: Optional[FaultPlan]):
+    """Install `plan` as the process-wide fault schedule (None resets
+    to disabled). Returns the active plan."""
+    global global_faults
+    global_faults = plan if plan is not None else _DISABLED
+    return global_faults
+
+
+def reset() -> None:
+    install(None)

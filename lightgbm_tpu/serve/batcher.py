@@ -52,7 +52,9 @@ class MicroBatcher:
         self.max_batch_rows = max(int(max_batch_rows), 1)
         self.max_wait_s = max(float(max_wait_s), 0.0)
         self._executor = executor
-        self._pending: List[Tuple[np.ndarray, asyncio.Future, object]] = []
+        # (x, future, trace, deadline, arrival_t0) per pending request
+        self._pending: List[Tuple[np.ndarray, asyncio.Future, object,
+                                  float, float]] = []
         self._pending_rows = 0
         self._timer = None
         self._oldest_t0 = 0.0
@@ -62,12 +64,17 @@ class MicroBatcher:
         return self._pending_rows
 
     # ------------------------------------------------------------------
-    def submit(self, x: np.ndarray, trace=None) -> Awaitable[np.ndarray]:
+    def submit(self, x: np.ndarray, trace=None,
+               deadline: float = 0.0) -> Awaitable[np.ndarray]:
         """Queue `x` ([B, F]) for the next coalesced dispatch; resolves
         to the raw [B, K] scores for exactly these rows. Must be called
         on the event-loop thread. `trace` (a server ``_RequestTrace``,
         present only while the tracer runs) receives this request's
-        queue-wait/device-time attribution and batch link at flush."""
+        queue-wait/device-time attribution and batch link at flush.
+        `deadline` (a ``time.perf_counter()`` timestamp, 0 = none): a
+        request still pending past its deadline is failed with
+        ``DeadlineExceeded`` at flush time and never rides a batch —
+        an expired waiter must not cost device work."""
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         if self._pending and \
@@ -79,7 +86,8 @@ class MicroBatcher:
             self._flush(loop)
         if not self._pending:
             self._oldest_t0 = time.perf_counter()
-        self._pending.append((x, fut, trace))
+        self._pending.append((x, fut, trace, deadline,
+                              time.perf_counter()))
         self._pending_rows += x.shape[0]
         if self._pending_rows >= self.max_batch_rows:
             self._flush(loop)
@@ -104,7 +112,25 @@ class MicroBatcher:
         self._pending = []
         self._pending_rows = 0
 
-        xs = [x for x, _, _ in batch]
+        # deadline enforcement (resilience): waiters whose budget
+        # expired while queued fail fast HERE and are excluded from the
+        # dispatched batch — they must not occupy device time
+        now = time.perf_counter()
+        expired = [(x, fut, t0) for x, fut, _, dl, t0 in batch
+                   if dl and now > dl]
+        if expired:
+            from ..resilience.errors import DeadlineExceeded
+            for x, fut, t0 in expired:
+                global_metrics.inc_counter("resilience/deadline_exceeded")
+                if not fut.done():
+                    fut.set_exception(DeadlineExceeded(
+                        f"request ({x.shape[0]} rows) expired in the "
+                        "batch queue", elapsed_s=now - t0))
+            batch = [e for e in batch if not (e[3] and now > e[3])]
+            if not batch:
+                return
+
+        xs = [x for x, _, _, _, _ in batch]
         xcat = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
         global_metrics.inc_counter("serve/batches")
         global_metrics.inc_counter("serve/batched_rows", xcat.shape[0])
@@ -114,7 +140,7 @@ class MicroBatcher:
         global_metrics.note_latency(
             "serve/batch_wait", time.perf_counter() - self._oldest_t0)
 
-        traces = [tr for _, _, tr in batch if tr is not None]
+        traces = [tr for _, _, tr, _, _ in batch if tr is not None]
         if traces:
             # queue wait ends now; the device span is timed on the
             # executor thread and linked back by batch_id
@@ -147,12 +173,12 @@ class MicroBatcher:
             try:
                 out = done.result()
             except BaseException as exc:  # propagate to every waiter
-                for _, fut, _ in batch:
+                for _, fut, _, _, _ in batch:
                     if not fut.done():
                         fut.set_exception(exc)
                 return
             lo = 0
-            for x, fut, _ in batch:
+            for x, fut, _, _, _ in batch:
                 hi = lo + x.shape[0]
                 if not fut.done():  # waiter may have been cancelled
                     fut.set_result(out[lo:hi])

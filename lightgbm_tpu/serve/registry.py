@@ -55,6 +55,23 @@ class ServedModel:
         """Raw scores through the AOT small-batch path (B <= 64-ish)."""
         return self.lowlat(data)
 
+    # -- serve dispatch twins: the ModelServer routes through these so
+    # the deterministic fault plan (resilience/faults.py) can inject
+    # transient pack/compile failures and executor-occupying slowness
+    # at the exact point real ones surface. Bound methods on purpose:
+    # the batcher re-binds on entry identity via __self__.
+    def dispatch_raw(self, data: np.ndarray) -> np.ndarray:
+        from ..resilience import faults as faults_mod
+        if faults_mod.global_faults.armed:
+            faults_mod.global_faults.check_serve_dispatch(self.name)
+        return self.model.predict_raw(data)
+
+    def dispatch_lowlat(self, data: np.ndarray) -> np.ndarray:
+        from ..resilience import faults as faults_mod
+        if faults_mod.global_faults.armed:
+            faults_mod.global_faults.check_serve_dispatch(self.name)
+        return self.lowlat(data)
+
     @property
     def lowlat(self) -> LowLatencyPredictor:
         if self._lowlat is None:
@@ -110,13 +127,22 @@ class ModelRegistry:
 
     # ------------------------------------------------------------------
     def load(self, name: str, model=None, model_file: Optional[str] = None,
-             model_str: Optional[str] = None, booster=None) -> ServedModel:
+             model_str: Optional[str] = None, booster=None,
+             validate: bool = False) -> ServedModel:
         """Register a model under `name` from exactly one source: an
         already-parsed LoadedModel, a text-format file, a model string,
         or a live Booster (snapshotted through its text serialization,
         so later training on the booster can't mutate the served trees).
-        Re-loading an existing name replaces it (and frees its packs)."""
+        Re-loading an existing name replaces it (and frees its packs).
+
+        Registration is TRANSACTIONAL: parsing, entry construction and
+        (with ``validate=True``) a one-row pack/predict smoke all run
+        BEFORE the registry is touched, so a failure mid-load — a
+        corrupt file, an injected registry fault, a pack explosion —
+        leaves the previous entry fully served and never a
+        partially-registered name (tests/test_resilience.py)."""
         from ..model_io import load_model_from_string
+        from ..resilience import faults as faults_mod
         sources = [s is not None for s in (model, model_file, model_str,
                                            booster)]
         if sum(sources) != 1:
@@ -129,10 +155,19 @@ class ModelRegistry:
             model = load_model_from_string(model_str)
         elif booster is not None:
             model = load_model_from_string(booster.model_to_string())
+        entry = ServedModel(name, model, self.lowlat_max_rows)
+        if faults_mod.global_faults.armed:
+            faults_mod.global_faults.check_registry_load(name)
+        if validate and model.trees:
+            # prove the entry can actually pack + predict before it
+            # replaces a working one (the "pack succeeds" gate; warm()
+            # extends this to the full bucket ladder server-side)
+            entry.predict_raw(
+                np.zeros((1, model.max_feature_idx + 1)))
+        # ---- commit point: nothing above mutated the registry -------
         old = self._entries.pop(name, None)
         if old is not None:
             old.drop_packs()
-        entry = ServedModel(name, model, self.lowlat_max_rows)
         self._entries[name] = entry
         self._preflight(entry)
         return entry

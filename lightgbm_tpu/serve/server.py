@@ -49,6 +49,9 @@ import numpy as np
 
 from ..obs.metrics import global_metrics
 from ..obs.trace import global_tracer
+from ..resilience.degrade import CircuitBreaker, backoff_delays
+from ..resilience.errors import (DeadlineExceeded, ServerOverloaded,
+                                 TransientServeError)
 from .batcher import MicroBatcher
 from .registry import ModelRegistry, ServedModel
 
@@ -79,13 +82,28 @@ _MIXED_SIZES = (1, 8, 64, 512, 16, 2048, 32, 4)
 class ModelServer:
     def __init__(self, registry: ModelRegistry,
                  max_batch_rows: int = 8192, max_wait_ms: float = 2.0,
-                 lowlat_max_rows: Optional[int] = None):
+                 lowlat_max_rows: Optional[int] = None,
+                 deadline_ms: float = 0.0, max_queue_rows: int = 0,
+                 retry_max: int = 2, retry_backoff_ms: float = 10.0,
+                 breaker_threshold: int = 5,
+                 breaker_reset_s: float = 30.0):
         self.registry = registry
         self.max_batch_rows = int(max_batch_rows)
         self.max_wait_s = float(max_wait_ms) / 1e3
         self.lowlat_max_rows = int(registry.lowlat_max_rows
                                    if lowlat_max_rows is None
                                    else lowlat_max_rows)
+        # graceful degradation under load (resilience/):
+        # per-request deadline, bounded admission, transient-fault
+        # retry schedule, per-model circuit breakers
+        self.deadline_s = max(float(deadline_ms), 0.0) / 1e3
+        self.max_queue_rows = int(max_queue_rows)
+        self.retry_max = max(int(retry_max), 0)
+        self.retry_backoff_s = max(float(retry_backoff_ms), 0.0) / 1e3
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_reset_s = float(breaker_reset_s)
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._queued_rows = 0  # admitted rows not yet answered
         # one device queue: batched AND low-latency dispatches serialize
         # here while the event loop keeps coalescing the next batch
         self._executor = ThreadPoolExecutor(
@@ -100,15 +118,36 @@ class ModelServer:
         if b is None or b._predict_fn.__self__ is not entry:
             # new or re-loaded entry: bind a fresh batcher to it
             b = self._batchers[entry.name] = MicroBatcher(
-                entry.predict_raw, max_batch_rows=self.max_batch_rows,
+                entry.dispatch_raw, max_batch_rows=self.max_batch_rows,
                 max_wait_s=self.max_wait_s, executor=self._executor)
         return b
+
+    def _breaker(self, entry: ServedModel) -> CircuitBreaker:
+        br = self._breakers.get(entry.name)
+        if br is None or getattr(br, "_entry", None) is not entry:
+            # new or re-loaded entry: a fresh model must not inherit
+            # the faults (or an open circuit) of the one it replaced
+            br = self._breakers[entry.name] = CircuitBreaker(
+                entry.name, threshold=self.breaker_threshold,
+                reset_s=self.breaker_reset_s)
+            br._entry = entry
+        return br
 
     async def predict(self, name: str, data, raw_score: bool = False
                       ) -> np.ndarray:
         """Serve one request against model `name`. Output shape/values
-        match ``LoadedModel.predict(data, raw_score=raw_score)``."""
+        match ``LoadedModel.predict(data, raw_score=raw_score)``.
+
+        Degradation contract (resilience/): a request older than the
+        server deadline fails fast with ``DeadlineExceeded``; arrivals
+        beyond the bounded admission queue are shed with
+        ``ServerOverloaded`` (retry-after hint); transient pack/compile
+        faults retry with exponential backoff; a model whose dispatches
+        keep faulting trips its circuit breaker and fails fast until
+        the half-open probe succeeds. Every event lands in the
+        ``resilience/*`` obs counters (``lgbmtpu_resilience_*``)."""
         t0 = time.perf_counter()
+        deadline = (t0 + self.deadline_s) if self.deadline_s > 0 else 0.0
         x = np.asarray(data, np.float64)
         if x.ndim == 1:
             x = x.reshape(1, -1)
@@ -121,36 +160,50 @@ class ModelServer:
             raise ValueError(
                 f"request has {x.shape[1]} features but model "
                 f"'{name}' expects {need}")
+        rows = int(x.shape[0])
+        if self.max_queue_rows > 0 and self._queued_rows > 0 and \
+                self._queued_rows + rows > self.max_queue_rows:
+            # bounded admission: shed the arrival BEFORE it costs any
+            # queue slot, breaker probe, or device work (an idle server
+            # still accepts a single oversized request, mirroring the
+            # batcher)
+            global_metrics.inc_counter("resilience/load_shed")
+            raise ServerOverloaded(
+                f"admission queue full ({self._queued_rows} rows "
+                f"pending, request adds {rows} > "
+                f"{self.max_queue_rows} allowed)",
+                retry_after_s=max(self.max_wait_s, 1e-3))
+        br = (self._breaker(entry) if self.breaker_threshold > 0
+              else None)
+        # open circuit -> CircuitOpenError, fail fast; probe_held marks
+        # whether THIS request is the single half-open probe (only then
+        # may a verdict-less death release the slot)
+        probe_held = br.admit() if br is not None else False
+        # route + count ONCE per request (retries reuse the routing
+        # but must not inflate the request-volume counters)
+        lowlat = (x.shape[0] <= min(self.lowlat_max_rows,
+                                    entry.lowlat_max_rows)
+                  and entry.supports_lowlat)
+        global_metrics.inc_counter("serve/lowlat_requests" if lowlat
+                                   else "serve/batched_requests")
         loop = asyncio.get_running_loop()
         # request-scoped tracing: one attribute check when the tracer is
         # off; otherwise the request gets a trace id and its queue/device
         # attribution is collected through whichever path serves it
         rt = _RequestTrace() if global_tracer.enabled else None
-        # a server-level threshold can only lower the routing cut below
-        # the per-entry AOT limit, never push requests past it
-        lowlat_cap = min(self.lowlat_max_rows, entry.lowlat_max_rows)
-        if x.shape[0] <= lowlat_cap and entry.supports_lowlat:
-            global_metrics.inc_counter("serve/lowlat_requests")
-            if rt is None:
-                raw = await loop.run_in_executor(
-                    self._executor, entry.lowlat_predict, x)
-            else:
-                rt.path = "lowlat"
-
-                def timed_lowlat(x=x, entry=entry, rt=rt):
-                    t_dev = time.perf_counter_ns()
-                    rt.queue_ns = t_dev - rt.t0_ns  # executor queue wait
-                    out = entry.lowlat_predict(x)
-                    rt.device_ns = time.perf_counter_ns() - t_dev
-                    return out
-
-                raw = await loop.run_in_executor(self._executor,
-                                                 timed_lowlat)
-        else:
-            global_metrics.inc_counter("serve/batched_requests")
-            if rt is not None:
-                rt.path = "batched"
-            raw = await self._batcher(entry).submit(x, trace=rt)
+        self._queued_rows += rows
+        try:
+            raw = await self._dispatch_with_retry(entry, x, rt, deadline,
+                                                  br, loop, lowlat)
+        except (DeadlineExceeded, asyncio.CancelledError):
+            # not a verdict on the model: a half-open PROBE that died
+            # this way frees its slot so the breaker can probe again
+            # (a closed-state admission holds no slot to free)
+            if br is not None and probe_held:
+                br.release_probe()
+            raise
+        finally:
+            self._queued_rows -= rows
         out = raw[:, 0] if raw.shape[1] == 1 else raw
         if not raw_score:
             from ..model_io import transform_raw
@@ -171,6 +224,86 @@ class ModelServer:
                 time.perf_counter_ns() - rt.t0_ns, args=args)
         self.registry.evict_to_budget()
         return out
+
+    # ------------------------------------------------------------------
+    async def _dispatch_with_retry(self, entry: ServedModel,
+                                   x: np.ndarray, rt, deadline: float,
+                                   br, loop, lowlat: bool) -> np.ndarray:
+        """Route one request (lowlat / batched) with exponential-backoff
+        retries of transient faults. Deadline and cancellation pass
+        straight through (load conditions, not model faults); any other
+        failure — transient retries exhausted included — counts against
+        the model's circuit breaker."""
+        delays = [0.0] + backoff_delays(self.retry_max,
+                                        self.retry_backoff_s)
+        last_exc: Optional[BaseException] = None
+        for i, delay in enumerate(delays):
+            if delay > 0:
+                await asyncio.sleep(delay)
+            if deadline and time.perf_counter() > deadline:
+                global_metrics.inc_counter("resilience/deadline_exceeded")
+                raise DeadlineExceeded(
+                    f"request expired before dispatch "
+                    f"(attempt {i + 1})",
+                    elapsed_s=time.perf_counter()
+                    - (deadline - self.deadline_s))
+            try:
+                out = await self._dispatch(entry, x, rt, deadline, loop,
+                                           lowlat)
+            except (DeadlineExceeded, asyncio.CancelledError):
+                raise
+            except TransientServeError as exc:
+                last_exc = exc
+                if i + 1 < len(delays):
+                    global_metrics.inc_counter("resilience/retries")
+                    continue
+                break  # retries exhausted -> breaker failure below
+            except Exception:
+                if br is not None:
+                    br.record_failure()
+                global_metrics.inc_counter("resilience/dispatch_failures")
+                raise
+            if br is not None:
+                br.record_success()
+            return out
+        if br is not None:
+            br.record_failure()
+        global_metrics.inc_counter("resilience/dispatch_failures")
+        raise last_exc
+
+    async def _dispatch(self, entry: ServedModel, x: np.ndarray, rt,
+                        deadline: float, loop,
+                        lowlat: bool) -> np.ndarray:
+        # the route was decided (and counted) once in predict(): the
+        # server-level threshold can only lower the routing cut below
+        # the per-entry AOT limit, never push requests past it
+        if lowlat:
+            if rt is not None:
+                rt.path = "lowlat"
+
+            def run_lowlat(x=x, entry=entry, rt=rt):
+                t_dev = time.perf_counter_ns()
+                if deadline and time.perf_counter() > deadline:
+                    # the executor queue ate the whole budget: fail
+                    # fast instead of spending device time on an
+                    # answer nobody is waiting for
+                    global_metrics.inc_counter(
+                        "resilience/deadline_exceeded")
+                    raise DeadlineExceeded(
+                        "request expired waiting for the serve "
+                        "executor")
+                if rt is not None:
+                    rt.queue_ns = t_dev - rt.t0_ns  # executor queue wait
+                out = entry.dispatch_lowlat(x)
+                if rt is not None:
+                    rt.device_ns = time.perf_counter_ns() - t_dev
+                return out
+
+            return await loop.run_in_executor(self._executor, run_lowlat)
+        if rt is not None:
+            rt.path = "batched"
+        return await self._batcher(entry).submit(x, trace=rt,
+                                                 deadline=deadline)
 
     # ------------------------------------------------------------------
     def warm(self, name: str, num_features: int) -> None:
@@ -217,6 +350,9 @@ class ModelServer:
             return render_openmetrics(extra_gauges={
                 "lgbmtpu_serve_pack_bytes": self.registry.pack_bytes(),
                 "lgbmtpu_serve_models": len(self.registry),
+                "lgbmtpu_resilience_queued_rows": self._queued_rows,
+                "lgbmtpu_resilience_breakers_open": sum(
+                    1 for b in self._breakers.values() if b.is_open),
             })
 
         if host is None:
@@ -235,7 +371,7 @@ class ModelServer:
                 "serve/batch_wait"),
             "counters": {k: v for k, v in
                          sorted(global_metrics.counters.items())
-                         if k.startswith("serve/")},
+                         if k.startswith(("serve/", "resilience/"))},
             "pack_bytes": self.registry.pack_bytes(),
         }
 
@@ -309,13 +445,23 @@ def serve_file(input_model: str, data_path: str, output_result: str,
     registry = ModelRegistry(max_pack_bytes=cfg.serve_cache_bytes,
                              lowlat_max_rows=cfg.serve_lowlat_max_rows,
                              predict_chunk_rows=cfg.tpu_predict_chunk)
-    entry = registry.load("default", model_file=input_model)
+    # validate=True: prove the model can pack + predict BEFORE the
+    # server starts taking traffic on it (serving startup, not a
+    # hot-swap — the upfront smoke is free relative to warm())
+    entry = registry.load("default", model_file=input_model,
+                          validate=True)
     data = conform_prediction_data(np.asarray(data, np.float64),
                                    entry.model.max_feature_idx + 1,
                                    cfg.predict_disable_shape_check)
     server = ModelServer(registry,
                          max_batch_rows=cfg.serve_max_batch_rows,
-                         max_wait_ms=cfg.serve_max_wait_ms)
+                         max_wait_ms=cfg.serve_max_wait_ms,
+                         deadline_ms=cfg.serve_deadline_ms,
+                         max_queue_rows=cfg.serve_max_queue_rows,
+                         retry_max=cfg.serve_retry_max,
+                         retry_backoff_ms=cfg.serve_retry_backoff_ms,
+                         breaker_threshold=cfg.serve_breaker_threshold,
+                         breaker_reset_s=cfg.serve_breaker_reset_s)
     metrics_port = None
     if int(cfg.serve_metrics_port) >= 0:
         metrics_port = server.start_metrics_endpoint(

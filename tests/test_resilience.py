@@ -1,0 +1,689 @@
+"""Fault-tolerant training & serving (ISSUE 11).
+
+- Checkpoint/resume bit-parity across the fixture matrix: train N
+  straight == train k / injected kill / resume / train N-k, asserted
+  on ``model_to_string()`` equality — plain, bagging, GOSS, DART,
+  linear-tree (+ feature_fraction RNG stream), quantized, 2-shard mesh.
+- The preemption exit-code contract (EXIT_PREEMPTED = 75) and the
+  SIGTERM handler plumbing.
+- Atomic checkpoint container: digest-footer rejection of corrupted /
+  truncated files, resume-mismatch detection.
+- engine.train interrupt safety: KeyboardInterrupt/SystemExit
+  mid-iteration returns the best-so-far booster and flushes obs.
+- Corrupt/truncated model files raise structured CorruptModelError
+  naming a byte offset.
+- Serve graceful degradation: per-request deadlines, bounded admission
+  with retry-after, transient-fault retry (bit-exact), per-model
+  circuit breaker incl. half-open recovery; transactional registry
+  registration under an injected load fault.
+- tools/check_resilience.py (quick-tier chaos validator) and
+  check_perf_gate.py check 7 (checkpoint-overhead ceiling).
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.basic import Booster
+from lightgbm_tpu.resilience import checkpoint as ckpt_mod
+from lightgbm_tpu.resilience import faults as faults_mod
+from lightgbm_tpu.resilience.degrade import CircuitBreaker
+from lightgbm_tpu.resilience.errors import (EXIT_PREEMPTED,
+                                            CircuitOpenError,
+                                            CorruptCheckpointError,
+                                            CorruptModelError,
+                                            DeadlineExceeded,
+                                            ResumeMismatchError,
+                                            ServerOverloaded,
+                                            TransientServeError)
+from lightgbm_tpu.obs.metrics import global_metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+N_ROUNDS = 8
+KILL_AT = 3
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    faults_mod.reset()
+
+
+def _data(n=264, f=8, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.2 * r.randn(n) > 0.4)
+    return X, y.astype(np.float32), (
+        X[:, 0] * 2 - X[:, 1] + 0.1 * r.randn(n)).astype(np.float32)
+
+
+# the resume-parity fixture matrix: every sampling / boosting / storage
+# mode whose iteration state differs structurally
+MATRIX = {
+    "plain": dict(objective="binary", num_leaves=7),
+    "bagging": dict(objective="binary", num_leaves=7,
+                    bagging_fraction=0.7, bagging_freq=2),
+    "goss": dict(objective="binary", num_leaves=7,
+                 data_sample_strategy="goss"),
+    "dart": dict(objective="binary", num_leaves=7, boosting="dart",
+                 drop_rate=0.5, max_drop=3),
+    "linear": dict(objective="regression", num_leaves=7,
+                   linear_tree=True, feature_fraction=0.8),
+    "quantized": dict(objective="binary", num_leaves=7,
+                      use_quantized_grad=True),
+    "shard2": dict(objective="binary", num_leaves=7, tpu_num_shards=2),
+}
+
+
+class TestResumeBitParity:
+    @pytest.mark.parametrize("name", sorted(MATRIX))
+    def test_kill_resume_bit_identical(self, name, tmp_path):
+        """train-N-straight == train-k, kill, resume, train-(N-k), to
+        the last bit of model_to_string()."""
+        X, y_bin, y_reg = _data()
+        extra = MATRIX[name]
+        label = y_reg if extra["objective"] == "regression" else y_bin
+        ck = str(tmp_path / f"{name}.ckpt")
+        params = dict(learning_rate=0.1, verbosity=-1,
+                      tpu_checkpoint_path=ck, **extra)
+
+        straight = lgb.train(dict(params), lgb.Dataset(X, label),
+                             num_boost_round=N_ROUNDS).model_to_string()
+        if os.path.exists(ck):
+            os.remove(ck)
+
+        faults_mod.install(faults_mod.FaultPlan(kill_at_iter=KILL_AT))
+        with pytest.raises(SystemExit) as exc_info:
+            lgb.train(dict(params), lgb.Dataset(X, label),
+                      num_boost_round=N_ROUNDS)
+        assert exc_info.value.code == EXIT_PREEMPTED
+        assert os.path.exists(ck), "preemption must leave a checkpoint"
+        faults_mod.reset()
+
+        resumed_bst = lgb.train(dict(params), lgb.Dataset(X, label),
+                                num_boost_round=N_ROUNDS)
+        assert resumed_bst.current_iteration() == N_ROUNDS
+        assert resumed_bst.model_to_string() == straight
+
+    def test_periodic_snapshots_written(self, tmp_path):
+        """tpu_checkpoint_every writes at every boundary multiple and
+        the totals feed obs meta (perf-gate check 7's input)."""
+        ckpt_mod.reset_totals()
+        X, y, _ = _data()
+        ck = str(tmp_path / "periodic.ckpt")
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1, "tpu_checkpoint_path": ck,
+                   "tpu_checkpoint_every": 2},
+                  lgb.Dataset(X, y), num_boost_round=6)
+        assert os.path.exists(ck)
+        totals = ckpt_mod.checkpoint_totals()
+        assert totals["checkpoints"] == 3       # iters 2, 4, 6
+        assert totals["seconds_total"] > 0
+        assert totals["last_iteration"] == 6
+        meta = global_metrics.meta.get("resilience_checkpoint")
+        assert meta and meta["checkpoints"] == 3
+        # the checkpoint is loadable and carries the model string
+        state = ckpt_mod.load_checkpoint(ck)
+        assert state["iteration"] == 6
+        assert "tree" in state["model_str"]
+
+    def test_preempt_on_early_stopped_run_marks_finished(self, tmp_path):
+        """SIGTERM landing on the iteration that early-stopped still
+        snapshots + exits 75, and the snapshot is marked finished: the
+        supervisor's re-run returns immediately with the recorded best
+        iteration instead of training the remaining rounds."""
+        import lightgbm_tpu.callback as cb_mod
+        X, y, _ = _data()
+        ck = str(tmp_path / "es.ckpt")
+        params = {"objective": "binary", "num_leaves": 7,
+                  "verbosity": -1, "tpu_checkpoint_path": ck}
+
+        def stop_and_preempt(env):
+            if env.iteration == 3:
+                # the preemption signal arrived during this iteration...
+                os.kill(os.getpid(), __import__("signal").SIGTERM)
+                # ...whose evaluation then decides to early-stop
+                raise cb_mod.EarlyStopException(2, [("t", "l2", 0.1, False)])
+
+        with pytest.raises(SystemExit) as ei:
+            lgb.train(dict(params), lgb.Dataset(X, y),
+                      num_boost_round=20, callbacks=[stop_and_preempt])
+        assert ei.value.code == EXIT_PREEMPTED
+        state = ckpt_mod.load_checkpoint(ck)
+        assert state["finished"] is True
+        resumed = lgb.train(dict(params), lgb.Dataset(X, y),
+                            num_boost_round=20,
+                            callbacks=[stop_and_preempt])
+        assert resumed.current_iteration() == 4  # no further training
+        assert resumed.best_iteration == 3       # restored, not -1
+
+    def test_resume_skips_completed_training(self, tmp_path):
+        """A checkpoint at or past the target round count returns the
+        restored booster without training further."""
+        X, y, _ = _data()
+        ck = str(tmp_path / "done.ckpt")
+        params = {"objective": "binary", "num_leaves": 7,
+                  "verbosity": -1, "tpu_checkpoint_path": ck,
+                  "tpu_checkpoint_every": 3}
+        done = lgb.train(dict(params), lgb.Dataset(X, y),
+                         num_boost_round=6)
+        again = lgb.train(dict(params), lgb.Dataset(X, y),
+                          num_boost_round=6)
+        assert again.current_iteration() == 6
+        assert again.model_to_string() == done.model_to_string()
+
+
+class TestCheckpointContainer:
+    def _checkpoint(self, tmp_path, **extra):
+        X, y, _ = _data(n=200)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1, **extra},
+                        lgb.Dataset(X, y), num_boost_round=3)
+        ck = str(tmp_path / "c.ckpt")
+        ckpt_mod.save_checkpoint(bst, ck)
+        return bst, ck
+
+    def test_corrupt_byte_rejected(self, tmp_path):
+        _, ck = self._checkpoint(tmp_path)
+        with open(ck, "r+b") as fh:
+            fh.seek(300)
+            b = fh.read(1)
+            fh.seek(300)
+            fh.write(bytes([b[0] ^ 0xFF]))
+        with pytest.raises(CorruptCheckpointError) as ei:
+            ckpt_mod.load_checkpoint(ck)
+        assert ei.value.offset is not None
+
+    def test_truncation_rejected(self, tmp_path):
+        _, ck = self._checkpoint(tmp_path)
+        data = open(ck, "rb").read()
+        with open(ck, "wb") as fh:
+            fh.write(data[:len(data) // 2])
+        with pytest.raises(CorruptCheckpointError):
+            ckpt_mod.load_checkpoint(ck)
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = str(tmp_path / "junk.ckpt")
+        with open(p, "wb") as fh:
+            fh.write(b"definitely not a checkpoint")
+        with pytest.raises(CorruptCheckpointError) as ei:
+            ckpt_mod.load_checkpoint(p)
+        assert ei.value.offset == 0
+
+    def test_fault_plan_corruption_rejected(self, tmp_path):
+        """The corrupt-checkpoint-byte fault flips a byte AFTER the
+        atomic rename; the digest must catch exactly that artifact."""
+        X, y, _ = _data(n=200)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1},
+                        lgb.Dataset(X, y), num_boost_round=3)
+        ck = str(tmp_path / "f.ckpt")
+        faults_mod.install(
+            faults_mod.FaultPlan(corrupt_checkpoint_byte=150))
+        ckpt_mod.save_checkpoint(bst, ck)
+        with pytest.raises(CorruptCheckpointError):
+            ckpt_mod.load_checkpoint(ck)
+
+    def test_resume_mismatch_detected(self, tmp_path):
+        """Resuming under a structurally different config must refuse,
+        not silently mix states."""
+        _, ck = self._checkpoint(tmp_path)
+        X, y, _ = _data(n=200)
+        with pytest.raises(ResumeMismatchError):
+            lgb.train({"objective": "binary", "num_leaves": 15,
+                       "verbosity": -1, "tpu_checkpoint_path": ck},
+                      lgb.Dataset(X, y), num_boost_round=3)
+
+    def test_corrupt_checkpoint_blocks_resume(self, tmp_path):
+        """engine.train must surface the corruption, never silently
+        retrain from scratch over a torn checkpoint."""
+        _, ck = self._checkpoint(tmp_path)
+        data = open(ck, "rb").read()
+        with open(ck, "wb") as fh:
+            fh.write(data[:200])
+        X, y, _ = _data(n=200)
+        with pytest.raises(CorruptCheckpointError):
+            lgb.train({"objective": "binary", "num_leaves": 7,
+                       "verbosity": -1, "tpu_checkpoint_path": ck},
+                      lgb.Dataset(X, y), num_boost_round=3)
+
+
+class TestInterruptSafety:
+    def test_keyboard_interrupt_mid_iteration(self, monkeypatch,
+                                              tmp_path):
+        """KeyboardInterrupt inside update() finalizes and returns the
+        best-so-far booster (and flushes the obs textfile) instead of
+        propagating with a half-updated booster."""
+        prom = str(tmp_path / "train.prom")
+        monkeypatch.setenv("LGBM_TPU_METRICS_FILE", prom)
+        from lightgbm_tpu.obs.export import global_flusher
+        global_flusher.rearm()
+        try:
+            calls = {"n": 0}
+            orig = Booster.update
+
+            def flaky(self, *args, **kwargs):
+                if calls["n"] == 3:
+                    raise KeyboardInterrupt
+                calls["n"] += 1
+                return orig(self, *args, **kwargs)
+
+            monkeypatch.setattr(Booster, "update", flaky)
+            X, y, _ = _data(n=200)
+            bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                             "verbosity": -1},
+                            lgb.Dataset(X, y), num_boost_round=8)
+            assert bst.current_iteration() == 3
+            assert bst.best_iteration == 3
+            # the model is consistent: it serializes and round-trips
+            assert lgb.Booster(model_str=bst.model_to_string())
+            assert os.path.exists(prom), \
+                "interrupt must flush the obs textfile"
+        finally:
+            monkeypatch.delenv("LGBM_TPU_METRICS_FILE", raising=False)
+            global_flusher.rearm()
+
+    def test_system_exit_from_callback_finalizes(self):
+        """A SystemExit raised by user code mid-loop also finalizes
+        (the engine's own preemption exit is raised OUTSIDE the guard
+        and still propagates — TestResumeBitParity asserts that)."""
+        def bomb(env):
+            if env.iteration == 2:
+                raise SystemExit(1)
+
+        X, y, _ = _data(n=200)
+        bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                         "verbosity": -1},
+                        lgb.Dataset(X, y), num_boost_round=8,
+                        callbacks=[bomb])
+        assert bst.current_iteration() == 3  # iterations 0..2 landed
+
+
+class TestFaultPlan:
+    def test_poison_labels_trips_health_sentinel(self):
+        """The poison-labels fault is a REALISTIC data fault: it flows
+        through the normal gradient path and the obs/health NaN
+        sentinel (tpu_health=error) must catch it within the poisoned
+        iteration."""
+        from lightgbm_tpu.obs.health import NonFiniteError
+        X, _, y_reg = _data(n=200)
+        faults_mod.install(
+            faults_mod.FaultPlan(poison_labels_at_iter=2))
+        with pytest.raises(NonFiniteError):
+            lgb.train({"objective": "regression", "num_leaves": 7,
+                       "verbosity": -1, "tpu_health": "error"},
+                      lgb.Dataset(X, y_reg), num_boost_round=6)
+        assert faults_mod.global_faults.fired("poison_labels") == 1
+
+    def test_slow_iteration_fault_fires_per_iteration(self):
+        plan = faults_mod.install(
+            faults_mod.FaultPlan(slow_iter_ms=1.0))
+        X, y, _ = _data(n=200)
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1}, lgb.Dataset(X, y),
+                  num_boost_round=3)
+        assert plan.fired("slow_iter") >= 3
+
+    def test_spec_parsing(self):
+        plan = faults_mod.FaultPlan.from_spec(
+            "kill_at_iter=4, serve_slow_ms=2.5,registry_load_failures=2")
+        assert plan.kill_at_iter == 4
+        assert plan.serve_slow_ms == 2.5
+        assert plan.registry_load_failures == 2
+        with pytest.raises(ValueError):
+            faults_mod.FaultPlan.from_spec("not_a_knob=1")
+        with pytest.raises(ValueError):
+            faults_mod.FaultPlan(bogus=1)
+
+
+class TestCorruptModelFiles:
+    def _model_str(self):
+        X, y, _ = _data(n=200)
+        return lgb.train({"objective": "binary", "num_leaves": 7,
+                          "verbosity": -1},
+                         lgb.Dataset(X, y),
+                         num_boost_round=4).model_to_string()
+
+    def test_mid_file_truncation_names_offset(self):
+        from lightgbm_tpu.model_io import load_model_from_string
+        s = self._model_str()
+        cut = s.index("Tree=2") + 120  # mid tree block
+        with pytest.raises(CorruptModelError) as ei:
+            load_model_from_string(s[:cut])
+        assert ei.value.offset is not None and 0 < ei.value.offset
+        assert "byte offset" in str(ei.value)
+
+    def test_truncated_model_file_via_booster(self, tmp_path):
+        s = self._model_str()
+        p = tmp_path / "trunc.txt"
+        p.write_text(s[:s.index("end of trees") - 25])
+        with pytest.raises(CorruptModelError):
+            lgb.Booster(model_file=str(p))
+
+    def test_header_truncation_rejected(self):
+        """A cut BEFORE the tree_sizes line must not load as a silent
+        0-tree model that serves constants."""
+        from lightgbm_tpu.model_io import load_model_from_string
+        s = self._model_str()
+        with pytest.raises(CorruptModelError):
+            load_model_from_string(s[:s.index("tree_sizes")])
+
+    def test_garbage_rejected_at_offset_zero(self):
+        from lightgbm_tpu.model_io import load_model_from_string
+        with pytest.raises(CorruptModelError) as ei:
+            load_model_from_string("this is not a model")
+        assert ei.value.offset == 0
+
+    def test_intact_model_still_parses(self):
+        from lightgbm_tpu.model_io import load_model_from_string
+        m = load_model_from_string(self._model_str())
+        assert len(m.trees) == 4
+
+
+# ---------------------------------------------------------------------------
+def _served(n_rounds=3):
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    X, y, _ = _data(n=400, f=6, seed=1)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, y),
+                    num_boost_round=n_rounds)
+    registry = ModelRegistry()
+    registry.load("m", booster=bst)
+    return registry, X
+
+
+class TestServeDegradation:
+    def test_deadline_fails_fast(self):
+        from lightgbm_tpu.serve.server import ModelServer
+        registry, X = _served()
+        srv = ModelServer(registry, deadline_ms=1e-6)
+        before = global_metrics.counter("resilience/deadline_exceeded")
+
+        async def run():
+            with pytest.raises(DeadlineExceeded):
+                await srv.predict("m", X[:200])
+            await srv.close()
+
+        asyncio.run(run())
+        assert global_metrics.counter(
+            "resilience/deadline_exceeded") > before
+
+    def test_expired_request_never_occupies_batcher(self):
+        """A request that expires while queued is failed at flush and
+        excluded from the dispatched batch; fresh requests still get
+        bit-exact answers."""
+        from lightgbm_tpu.serve.batcher import MicroBatcher
+        registry, X = _served()
+        entry = registry.get("m")
+        direct = entry.model.predict_raw(X[100:200])
+
+        async def run():
+            import time as _t
+            b = MicroBatcher(entry.predict_raw, max_batch_rows=4096,
+                             max_wait_s=0.05)
+            dead = b.submit(X[:100], deadline=_t.perf_counter() - 1.0)
+            live = b.submit(X[100:200])
+            with pytest.raises(DeadlineExceeded):
+                await dead
+            out = await live
+            assert np.array_equal(np.asarray(out), direct)
+
+        asyncio.run(run())
+
+    def test_admission_queue_sheds_with_retry_after(self):
+        from lightgbm_tpu.serve.server import ModelServer
+        registry, X = _served()
+        faults_mod.install(faults_mod.FaultPlan(serve_slow_ms=120))
+        srv = ModelServer(registry, max_queue_rows=64)
+        before = global_metrics.counter("resilience/load_shed")
+
+        async def run():
+            first = asyncio.ensure_future(srv.predict("m", X[:60]))
+            await asyncio.sleep(0.02)
+            with pytest.raises(ServerOverloaded) as ei:
+                await srv.predict("m", X[:60])
+            assert ei.value.retry_after_s > 0
+            await first  # the admitted request still completes
+            await srv.close()
+
+        asyncio.run(run())
+        assert global_metrics.counter("resilience/load_shed") > before
+
+    def test_transient_fault_retried_bit_exact(self):
+        from lightgbm_tpu.serve.server import ModelServer
+        registry, X = _served()
+        direct = registry.get("m").model.predict(X[:4])
+        faults_mod.install(
+            faults_mod.FaultPlan(serve_predict_failures=1))
+        srv = ModelServer(registry, retry_max=2, retry_backoff_ms=1)
+        before = global_metrics.counter("resilience/retries")
+
+        async def run():
+            out = await srv.predict("m", X[:4])
+            assert np.array_equal(np.asarray(out), np.asarray(direct))
+            await srv.close()
+
+        asyncio.run(run())
+        assert global_metrics.counter("resilience/retries") > before
+
+    def test_breaker_trips_and_fails_fast(self):
+        from lightgbm_tpu.serve.server import ModelServer
+        registry, X = _served()
+        faults_mod.install(
+            faults_mod.FaultPlan(serve_predict_failures=100))
+        srv = ModelServer(registry, retry_max=0, breaker_threshold=3,
+                          breaker_reset_s=60.0)
+
+        async def run():
+            for _ in range(3):
+                with pytest.raises(TransientServeError):
+                    await srv.predict("m", X[:4])
+            with pytest.raises(CircuitOpenError) as ei:
+                await srv.predict("m", X[:4])
+            assert ei.value.retry_after_s > 0
+            await srv.close()
+
+        asyncio.run(run())
+        assert srv._breakers["m"].is_open
+
+    def test_breaker_probe_death_releases_slot(self):
+        """A half-open probe that dies WITHOUT a verdict on the model
+        (deadline expiry / cancellation / shed) must release its slot —
+        otherwise the breaker would deny the model service forever."""
+        br = CircuitBreaker("x", threshold=1, reset_s=0.02)
+        br.record_failure()
+        assert br.is_open
+        import time as _t
+        _t.sleep(0.03)
+        br.admit()          # half-open, probe slot taken
+        with pytest.raises(CircuitOpenError):
+            br.admit()      # second concurrent probe rejected
+        br.release_probe()  # probe died via deadline, not model fault
+        br.admit()          # a fresh probe may go immediately
+        br.record_success()
+        assert br.state == "closed"
+
+    def test_deadline_killed_probe_reopens_breaker_path(self):
+        """End-to-end: breaker trips, half-opens, the probe request
+        expires via deadline — the NEXT request must still be able to
+        probe (no permanent 'probe in flight' lockout)."""
+        from lightgbm_tpu.serve.server import ModelServer
+        registry, X = _served()
+        faults_mod.install(
+            faults_mod.FaultPlan(serve_predict_failures=2))
+        srv = ModelServer(registry, retry_max=0, breaker_threshold=2,
+                          breaker_reset_s=0.05)
+
+        async def run():
+            for _ in range(2):
+                with pytest.raises(TransientServeError):
+                    await srv.predict("m", X[:4])
+            assert srv._breakers["m"].is_open
+            await asyncio.sleep(0.06)
+            # half-open probe, killed by an expired deadline
+            srv.deadline_s = 1e-9
+            with pytest.raises(DeadlineExceeded):
+                await srv.predict("m", X[:4])
+            # slot released: the next probe goes through and closes
+            srv.deadline_s = 0.0
+            faults_mod.reset()
+            out = await srv.predict("m", X[:4])
+            assert out is not None
+            assert srv._breakers["m"].state == "closed"
+            await srv.close()
+
+        asyncio.run(run())
+
+    def test_registry_validate_smoke_gates_registration(self, monkeypatch):
+        """validate=True proves pack+predict BEFORE the swap: a model
+        that cannot predict must not replace a working entry."""
+        from lightgbm_tpu.model_io import LoadedModel
+        registry, X = _served()
+        old_entry = registry.get("m")
+        X2, y2, _ = _data(n=200)
+        bst2 = lgb.train({"objective": "binary", "num_leaves": 7,
+                          "verbosity": -1}, lgb.Dataset(X2, y2),
+                         num_boost_round=2)
+
+        def broken(self, data, **kw):
+            raise RuntimeError("pack exploded")
+
+        monkeypatch.setattr(LoadedModel, "predict_raw", broken)
+        with pytest.raises(RuntimeError):
+            registry.load("m", booster=bst2, validate=True)
+        monkeypatch.undo()
+        assert registry.get("m") is old_entry  # old entry kept serving
+        entry = registry.load("m", booster=bst2, validate=True)
+        assert registry.get("m") is entry
+
+    def test_reloading_model_resets_its_breaker(self):
+        """A fixed model re-loaded under the same name must not fail
+        fast on the broken predecessor's open circuit."""
+        from lightgbm_tpu.serve.server import ModelServer
+        registry, X = _served()
+        faults_mod.install(
+            faults_mod.FaultPlan(serve_predict_failures=2))
+        srv = ModelServer(registry, retry_max=0, breaker_threshold=2,
+                          breaker_reset_s=60.0)
+
+        async def run():
+            for _ in range(2):
+                with pytest.raises(TransientServeError):
+                    await srv.predict("m", X[:4])
+            with pytest.raises(CircuitOpenError):
+                await srv.predict("m", X[:4])
+            faults_mod.reset()
+            # operator ships a fixed model under the same name
+            X2, y2, _ = _data(n=200, f=6, seed=1)
+            bst2 = lgb.train({"objective": "binary", "num_leaves": 7,
+                              "verbosity": -1}, lgb.Dataset(X2, y2),
+                             num_boost_round=2)
+            registry.load("m", booster=bst2)
+            out = await srv.predict("m", X[:4])  # fresh breaker, flows
+            assert out is not None
+            await srv.close()
+
+        asyncio.run(run())
+
+    def test_breaker_half_open_recovers(self):
+        br = CircuitBreaker("x", threshold=2, reset_s=0.02)
+        br.record_failure()
+        br.record_failure()
+        assert br.is_open
+        with pytest.raises(CircuitOpenError):
+            br.admit()
+        import time as _t
+        _t.sleep(0.03)
+        br.admit()  # half-open probe admitted
+        br.record_success()
+        assert br.state == "closed"
+        br.admit()  # closed again: flows freely
+
+    def test_registry_load_transactional(self):
+        registry, X = _served()
+        old_entry = registry.get("m")
+        faults_mod.install(
+            faults_mod.FaultPlan(registry_load_failures=2))
+        X2, y2, _ = _data(n=200)
+        bst2 = lgb.train({"objective": "binary", "num_leaves": 7,
+                          "verbosity": -1}, lgb.Dataset(X2, y2),
+                         num_boost_round=2)
+        with pytest.raises(TransientServeError):
+            registry.load("m", booster=bst2)
+        with pytest.raises(TransientServeError):
+            registry.load("m_new", booster=bst2)
+        faults_mod.reset()
+        # the failed re-load left the OLD entry fully served ...
+        assert registry.get("m") is old_entry
+        # ... and the failed fresh load registered nothing
+        assert "m_new" not in registry
+        # without the fault, load succeeds and replaces
+        registry.load("m", booster=bst2)
+        assert registry.get("m") is not old_entry
+
+
+class TestToolsWiring:
+    def test_check_resilience_tool(self):
+        """The chaos validator passes in-process (quick-tier wiring,
+        same idiom as check_health)."""
+        import check_resilience
+        assert check_resilience.main() == 0
+
+    def test_perf_gate_check7_skips_without_checkpointing(self, capsys):
+        import check_perf_gate
+        with open(check_perf_gate.FLOOR_PATH) as fh:
+            floor = json.load(fh)
+        assert floor["resilience"]["max_checkpoint_time_share"] > 0
+        failures = []
+        check_perf_gate.check_resilience_overhead(
+            floor, failures, [("BENCH_a.json", {"unit": "iters/sec"})])
+        assert failures == []
+        assert "skipped" in capsys.readouterr().out
+
+    def test_perf_gate_check7_flags_slow_snapshots(self):
+        import check_perf_gate
+        with open(check_perf_gate.FLOOR_PATH) as fh:
+            floor = json.load(fh)
+        lines = [("BENCH_x.json", {
+            "unit": "iters/sec (platform=cpu)",
+            "resilience": {"checkpoints": 4,
+                           "checkpoint_seconds_total": 5.0,
+                           "train_seconds": 10.0}})]
+        failures = []
+        check_perf_gate.check_resilience_overhead(floor, failures, lines)
+        assert len(failures) == 1 and "checkpoint overhead" in failures[0]
+
+        ok = [("BENCH_x.json", {
+            "unit": "iters/sec (platform=cpu)",
+            "resilience": {"checkpoints": 4,
+                           "checkpoint_seconds_total": 0.1,
+                           "train_seconds": 10.0}})]
+        failures = []
+        check_perf_gate.check_resilience_overhead(floor, failures, ok)
+        assert failures == []
+
+    def test_checkpoint_metrics_exported(self, tmp_path):
+        """The checkpoint accounting surfaces as lgbmtpu_resilience_*
+        families in the OpenMetrics render."""
+        X, y, _ = _data(n=200)
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1,
+                   "tpu_checkpoint_path": str(tmp_path / "e.ckpt"),
+                   "tpu_checkpoint_every": 2},
+                  lgb.Dataset(X, y), num_boost_round=4)
+        from lightgbm_tpu.obs.export import render_openmetrics
+        text = render_openmetrics()
+        assert "lgbmtpu_resilience_checkpoints_total" in text
+        assert "lgbmtpu_resilience_checkpoint_seconds_total" in text
+        import check_metrics_endpoint
+        errors, families = check_metrics_endpoint.validate_exposition(text)
+        assert not errors, errors[:5]
+        assert families["lgbmtpu_resilience_checkpoints_total"] == \
+            "counter"

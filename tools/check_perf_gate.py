@@ -2,7 +2,7 @@
 """Perf-regression gate (ROADMAP item 4: convert "should be fast" into
 driver-visible proof).
 
-Six checks, all against the recorded floor in tools/perf_floor.json:
+Seven checks, all against the recorded floor in tools/perf_floor.json:
 
 1. **Histogram traffic model** — recomputes the static per-iteration
    HBM byte model (learner.hist_traffic_model) for the recorded
@@ -58,6 +58,13 @@ Six checks, all against the recorded floor in tools/perf_floor.json:
    measured train seconds) must not make iterations comms-bound.
    No mesh run recorded => the check reports itself skipped — the
    same graceful-skip pattern as the other obs pillars.
+
+7. **Checkpoint overhead** — over the ``resilience`` dict bench.py
+   folds into its JSON line when a run checkpointed
+   (resilience/checkpoint.py): the snapshot wall-time share of train
+   wall-time must stay under the floor-configured ceiling — fault
+   tolerance is only free if the snapshots are. Graceful skip when no
+   checkpointing ran (the common bench config).
 
 Exit 0 = gate passed; exit 1 = regression, with one line per failure.
 Wired into the quick verification tier via tests/test_perf_gate.py.
@@ -392,6 +399,44 @@ def check_health_summaries(floor, failures, lines):
              ", no collective share estimate"))
 
 
+def check_resilience_overhead(floor, failures, lines):
+    """Checkpoint-overhead ceiling (check 7): the latest record that
+    actually checkpointed (bench `resilience` field) may not have spent
+    more than the configured share of train wall-time writing
+    snapshots. No checkpointing recorded => the check reports itself
+    skipped — same graceful-skip pattern as the obs pillars."""
+    cfg = floor.get("resilience")
+    if not cfg:
+        print("# no resilience floor recorded; checkpoint-overhead "
+              "check skipped")
+        return
+    with_res = [(tag, rec) for tag, rec in lines
+                if isinstance(rec.get("resilience"), dict)]
+    if not with_res:
+        print("# no checkpointing ran in any recorded bench; "
+              "checkpoint-overhead check skipped")
+        return
+    tag, rec = with_res[-1]
+    rs = rec["resilience"]
+    ck_s = float(rs.get("checkpoint_seconds_total", 0.0))
+    train_s = float(rs.get("train_seconds", 0.0))
+    n = int(rs.get("checkpoints", 0))
+    if n <= 0 or train_s <= 0.0:
+        print(f"# resilience[{tag}]: no snapshots recorded; "
+              "checkpoint-overhead check skipped")
+        return
+    share = ck_s / train_s
+    max_share = float(cfg.get("max_checkpoint_time_share", 0.15))
+    if share > max_share:
+        failures.append(
+            f"{tag}: checkpoint overhead {share:.2%} of train wall-time "
+            f"({ck_s:.3f}s snapshots / {train_s:.3f}s train over {n} "
+            f"snapshot(s)) exceeds the {max_share:.0%} ceiling")
+    else:
+        print(f"# resilience[{tag}]: checkpoint share {share:.2%} over "
+              f"{n} snapshot(s) (ceiling {max_share:.0%})")
+
+
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
@@ -446,6 +491,7 @@ def main(argv=None) -> int:
     check_bench_trajectory(floor, failures, lines, candidate_rec)
     check_phase_trajectory(floor, failures, lines)
     check_health_summaries(floor, failures, lines)
+    check_resilience_overhead(floor, failures, lines)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")

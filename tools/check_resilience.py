@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""Chaos validator for the resilience layer (ISSUE 11).
+
+Drives the REAL recovery paths with the deterministic fault plan
+(lightgbm_tpu/resilience/faults.py) and fails loudly if any of them
+regressed — this is how the checkpoint/resume, corruption-rejection
+and graceful-degradation code stays honest instead of untested:
+
+1. **Kill/resume bit-parity** — train N iterations straight, then
+   train with an injected preemption at iteration k (the SIGTERM path:
+   finish the iteration, snapshot, ``SystemExit(EXIT_PREEMPTED)``),
+   re-run the same command to resume, and assert the final
+   ``model_to_string()`` is BIT-identical to the uninterrupted run.
+2. **Corruption rejection** — flip one payload byte of the checkpoint
+   just written (fault plan) and assert the loader refuses with
+   ``CorruptCheckpointError``; truncate a model file mid-ensemble and
+   assert ``CorruptModelError`` names a byte offset.
+3. **Serve degradation observed via /metrics** — against a live
+   ``ModelServer`` with its OpenMetrics endpoint: an expired deadline
+   fails fast, an overloaded admission queue sheds with retry-after,
+   an injected transient fault is retried to a bit-exact answer, and
+   repeated faults trip the per-model circuit breaker — each observed
+   as a nonzero ``lgbmtpu_resilience_*`` family in a real ``/metrics``
+   scrape, plus the breaker-open gauge.
+
+Exit 0 = all steps passed. Wired into the quick verification tier via
+tests/test_resilience.py.
+"""
+
+import asyncio
+import os
+import sys
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def _fixture(n=260, f=6, seed=3):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.2 * r.randn(n) > 0.4)
+    return X, y.astype(np.float32)
+
+
+def step1_kill_resume(tmpdir) -> None:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.resilience import faults as fm
+    from lightgbm_tpu.resilience.errors import EXIT_PREEMPTED
+
+    X, y = _fixture()
+    ck = os.path.join(tmpdir, "train.ckpt")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "bagging_fraction": 0.8, "bagging_freq": 2,
+              "tpu_checkpoint_path": ck, "tpu_checkpoint_every": 3}
+    straight = lgb.train(dict(params), lgb.Dataset(X, y),
+                         num_boost_round=8).model_to_string()
+    os.remove(ck)
+
+    fm.install(fm.FaultPlan(kill_at_iter=4))
+    try:
+        lgb.train(dict(params), lgb.Dataset(X, y), num_boost_round=8)
+        raise AssertionError("injected preemption did not exit")
+    except SystemExit as e:
+        assert e.code == EXIT_PREEMPTED, \
+            f"preemption exit code {e.code} != {EXIT_PREEMPTED}"
+    finally:
+        fm.reset()
+    assert os.path.exists(ck), "preemption left no checkpoint"
+
+    resumed = lgb.train(dict(params), lgb.Dataset(X, y),
+                        num_boost_round=8).model_to_string()
+    assert resumed == straight, \
+        "resumed model is NOT bit-identical to the uninterrupted run"
+    print("# step 1 OK: kill@4 -> resume -> bit-identical "
+          "model_to_string (exit code contract honored)")
+
+
+def step2_corruption(tmpdir) -> None:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.resilience import checkpoint as ckpt
+    from lightgbm_tpu.resilience import faults as fm
+    from lightgbm_tpu.resilience.errors import (CorruptCheckpointError,
+                                                CorruptModelError)
+    from lightgbm_tpu.model_io import load_model_from_string
+
+    X, y = _fixture()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, y),
+                    num_boost_round=4)
+    ck = os.path.join(tmpdir, "corrupt.ckpt")
+    fm.install(fm.FaultPlan(corrupt_checkpoint_byte=200))
+    try:
+        ckpt.save_checkpoint(bst, ck)
+    finally:
+        fm.reset()
+    try:
+        ckpt.load_checkpoint(ck)
+        raise AssertionError("corrupt checkpoint was ACCEPTED")
+    except CorruptCheckpointError as e:
+        assert e.offset is not None
+    # truncated model file -> structured error naming a byte offset
+    s = bst.model_to_string()
+    frag = s[:s.index("end of trees") - 30]
+    try:
+        load_model_from_string(frag)
+        raise AssertionError("truncated model was ACCEPTED")
+    except CorruptModelError as e:
+        assert e.offset is not None and e.offset > 0
+    print("# step 2 OK: corrupt checkpoint + truncated model both "
+          "rejected with structured errors (byte offsets named)")
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+        return resp.read().decode()
+
+
+def _family(text: str, name: str) -> float:
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith(name) and not line.startswith("#"):
+            try:
+                total += float(line.rsplit(" ", 1)[1])
+            except ValueError:
+                pass
+    return total
+
+
+def step3_serve_degradation() -> None:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.resilience import faults as fm
+    from lightgbm_tpu.resilience.errors import (CircuitOpenError,
+                                                DeadlineExceeded,
+                                                ServerOverloaded,
+                                                TransientServeError)
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    from lightgbm_tpu.serve.server import ModelServer
+
+    X, y = _fixture(400)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, y),
+                    num_boost_round=3)
+    registry = ModelRegistry()
+    registry.load("m", booster=bst)
+    direct = registry.get("m").model.predict(X[:4])
+
+    async def run() -> None:
+        # deadline: an effectively-zero budget fails fast, batcher
+        # never spends device time on it. Breaker knobs are fixed at
+        # construction (the per-model breaker latches its threshold on
+        # first use).
+        srv = ModelServer(registry, deadline_ms=1e-6,
+                          breaker_threshold=3, breaker_reset_s=60.0)
+        ep = srv.start_metrics_endpoint(0)
+        try:
+            await srv.predict("m", X[:200])
+            raise AssertionError("expired deadline was served")
+        except DeadlineExceeded:
+            pass
+
+        # load shed: a slow dispatch occupies the queue; the second
+        # concurrent arrival exceeds the row bound and is shed with
+        # retry-after semantics
+        srv.max_queue_rows = 64
+        srv.deadline_s = 0.0
+        fm.install(fm.FaultPlan(serve_slow_ms=120))
+        first = asyncio.ensure_future(srv.predict("m", X[:60]))
+        await asyncio.sleep(0.02)
+        try:
+            await srv.predict("m", X[:60])
+            raise AssertionError("overload was admitted")
+        except ServerOverloaded as e:
+            assert e.retry_after_s > 0
+        await first
+        fm.reset()
+
+        # retry-to-success: one injected transient pack fault, answer
+        # still bit-exact
+        fm.install(fm.FaultPlan(serve_predict_failures=1))
+        srv.retry_max, srv.retry_backoff_s = 2, 0.001
+        out = await srv.predict("m", X[:4])
+        assert np.array_equal(np.asarray(out), np.asarray(direct)), \
+            "retried answer is not bit-identical to direct predict"
+        fm.reset()
+
+        # breaker: persistent faults trip it; fail-fast while open
+        fm.install(fm.FaultPlan(serve_predict_failures=100))
+        srv.retry_max = 0
+        for _ in range(3):
+            try:
+                await srv.predict("m", X[:4])
+            except TransientServeError:
+                pass
+        try:
+            await srv.predict("m", X[:4])
+            raise AssertionError("open breaker admitted a request")
+        except CircuitOpenError as e:
+            assert e.retry_after_s > 0
+        fm.reset()
+
+        # every degradation event must be visible in a REAL scrape
+        text = _scrape(ep.port)
+        for fam, floor in (
+                ("lgbmtpu_resilience_deadline_exceeded_total", 1),
+                ("lgbmtpu_resilience_load_shed_total", 1),
+                ("lgbmtpu_resilience_retries_total", 1),
+                ("lgbmtpu_resilience_breaker_open_total", 1),
+                ("lgbmtpu_resilience_breaker_rejected_total", 1),
+                ("lgbmtpu_resilience_breakers_open", 1)):
+            got = _family(text, fam)
+            assert got >= floor, \
+                f"/metrics family {fam} = {got}, expected >= {floor}"
+        await srv.close()
+
+    asyncio.run(run())
+    print("# step 3 OK: deadline fail-fast, load shed w/ retry-after, "
+          "transient retry (bit-exact), breaker trip — all observed "
+          "via /metrics lgbmtpu_resilience_* families")
+
+
+def main() -> int:
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmpdir:
+        step1_kill_resume(tmpdir)
+        step2_corruption(tmpdir)
+        step3_serve_degradation()
+    print("# resilience chaos validator OK (3/3 steps)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
