@@ -57,7 +57,7 @@ BASELINE_IPS = 500.0 / 130.094  # reference CPU Higgs-10.5M iters/sec
 RELAY_PORTS = (8082, 8083, 8087)
 
 
-_BENCH_MODES = ("train", "predict", "serve")
+_BENCH_MODES = ("train", "predict", "serve", "continual")
 
 
 def parse_bench_mode(argv=None, environ=None) -> str:
@@ -179,14 +179,16 @@ def _replay_child_stderr(path: str) -> None:
 
 
 _MODE_DEFAULT_ROWS = {"train": 10_500_000, "predict": 8_000_000,
-                      "serve": 2_000_000}
+                      "serve": 2_000_000, "continual": 2_000_000}
 # CPU-fallback shard sizes: the 1-core host must finish in budget (see
 # the fallback comment below); inference modes keep more rows than
 # training, and --serve pays per-request scheduling on top of traversal
-_MODE_CPU_ROWS = {"train": 50_000, "predict": 300_000, "serve": 150_000}
+_MODE_CPU_ROWS = {"train": 50_000, "predict": 300_000, "serve": 150_000,
+                  "continual": 40_000}
 _MODE_METRIC = {"train": "boosting_iters_per_sec_higgs_shape",
                 "predict": "predict_rows_per_sec",
-                "serve": "serve_rows_per_sec"}
+                "serve": "serve_rows_per_sec",
+                "continual": "continual_rows_per_sec"}
 
 
 def main():
@@ -762,8 +764,87 @@ def _measure_serve():
              lat["p50_ms"], lat["p99_ms"], bit_equal), file=sys.stderr)
 
 
+def _measure_continual():
+    """Continual-training bench (resilience/continual.py): BENCH_ROWS
+    of Higgs-shaped data ingested in BENCH_CONTINUAL_GENERATIONS
+    chunks, one generation per chunk (init_model continuation +
+    eval-anomaly gate + validated hot-swap into a live ModelRegistry).
+    Emits ingested rows/sec plus the `continual` summary dict —
+    swap/rollback overhead share included, which perf-gate check 8
+    caps. vs_baseline anchors against the no-continual alternative
+    measured in the same run: ONE monolithic train on the full data
+    for the same total iteration count (what a fleet would rerun from
+    scratch on every refresh)."""
+    n = int(os.environ.get("BENCH_ROWS", 40_000))
+    gens = int(os.environ.get("BENCH_CONTINUAL_GENERATIONS", 5))
+    rounds = int(os.environ.get("BENCH_CONTINUAL_ROUNDS", 10))
+    f = 28
+
+    import jax
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import ModelRegistry
+
+    platform = jax.default_backend()
+    rng = np.random.RandomState(0)
+    X = rng.randn(n, f).astype(np.float32)
+    logit = X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.3 * X[:, 2] * X[:, 3]
+    y = (logit + 0.2 * rng.randn(n) > 0.5).astype(np.float32)
+
+    params = {"objective": "binary", "max_bin": 63, "num_leaves": 255,
+              "learning_rate": 0.1, "min_sum_hessian_in_leaf": 100,
+              "verbosity": -1, "tpu_continual_rounds": rounds,
+              "tpu_continual_eval_fraction": 0.2}
+    registry = ModelRegistry()
+    trainer = lgb.ContinualTrainer(params, num_features=f,
+                                   registry=registry,
+                                   serve_name="bench-continual")
+    bounds = np.linspace(0, n, gens + 1).astype(int)
+    t0 = time.perf_counter()
+    for g in range(gens):
+        s, e = bounds[g], bounds[g + 1]
+        trainer.push_rows(X[s:e], label=y[s:e])
+        trainer.step()
+    wall = time.perf_counter() - t0
+
+    # the no-continual anchor: one monolithic train over everything,
+    # same total iteration budget, measured in the same run
+    t0 = time.perf_counter()
+    lgb.train(dict(params), lgb.Dataset(X, label=y),
+              num_boost_round=gens * rounds)
+    mono_wall = time.perf_counter() - t0
+
+    summary = trainer.summary()
+    overhead = summary["swap_seconds_total"] + max(
+        wall - summary["train_seconds_total"]
+        - summary["swap_seconds_total"], 0.0)
+    record = {
+        "metric": "continual_rows_per_sec",
+        "value": round(n / wall, 3),
+        "unit": f"rows/sec (n={n} gens={gens} rounds={rounds} "
+                f"platform={platform})",
+        "vs_baseline": round(mono_wall / wall, 4),
+        "continual": dict(summary,
+                          wall_seconds=round(wall, 3),
+                          overhead_seconds=round(overhead, 3),
+                          swap_share=round(
+                              summary["swap_seconds_total"] / wall, 6),
+                          monolithic_wall_seconds=round(mono_wall, 3)),
+    }
+    out = os.environ.get("BENCH_OUT")
+    line = json.dumps(record)
+    if out:
+        with open(out, "w") as fh:
+            fh.write(line + "\n")
+    else:
+        print(line, flush=True)
+    print(f"# continual: {summary['generations']} generation(s), "
+          f"{summary['rollbacks']} rollback(s), swap share "
+          f"{record['continual']['swap_share']:.2%}", file=sys.stderr)
+
+
 _MODE_MEASURE = {"train": _measure, "predict": _measure_predict,
-                 "serve": _measure_serve}
+                 "serve": _measure_serve, "continual": _measure_continual}
 
 
 def _emit_partial_obs(mode: str, exc) -> None:

@@ -15,7 +15,8 @@ from .callback import (EarlyStopException, early_stopping,  # noqa: F401
 from . import obs  # noqa: F401
 from .obs.memory import preflight  # noqa: F401  (HBM capacity planner)
 from . import serve  # noqa: F401
-from .engine import CVBooster, cv, train  # noqa: F401
+from .engine import CVBooster, continual_train, cv, train  # noqa: F401
+from .resilience.continual import ContinualTrainer  # noqa: F401
 from .log import register_logger  # noqa: F401
 from . import plotting  # noqa: F401
 from .plotting import (create_tree_digraph, plot_importance,  # noqa: F401

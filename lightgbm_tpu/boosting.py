@@ -261,8 +261,14 @@ class GBDT:
         self._valid_scores: List[np.ndarray] = []
         # grown-tree jit (shared across iterations; one XLA program per tree)
         self._build_grow(hist_ops.resolve_impl(config.tpu_hist_impl))
-        self._update_score = jax.jit(
-            lambda score, leaf_vals, row_leaf: score + leaf_vals[row_leaf])
+        # slow-path twin of the fused program's score update: the
+        # multiply and the add must live in ONE program so XLA makes the
+        # same FMA-contraction choice as inside the fused iteration —
+        # split across two jits the add rounds separately and the paths
+        # drift by one ulp, which flips sign-function gradients (L1
+        # family) on rows sitting at score == label
+        self._update_score_shrunk = jax.jit(
+            lambda score, lv, lr, row_leaf: score + (lv * lr)[row_leaf])
 
     def _maybe_pack_bins(self, binned):
         """Bit-packed device bins for `binned`, or None when ineligible
@@ -1268,6 +1274,15 @@ class GBDT:
                         np.asarray(row_leaf), np.asarray(true_grad),
                         np.asarray(true_hess), np.asarray(mask),
                         self.config.linear_lambda)
+                # pre-shrinkage leaf values are exactly f32 (grower
+                # output / traced renewal); captured before the f64
+                # host shrinkage so the score update below can multiply
+                # in f32 — the SAME rounding the fused program applies
+                # (rec.leaf_value * lr). A one-ulp score skew here flips
+                # sign-function gradients (L1 family) on rows sitting
+                # at score == label, which cascades into different
+                # splits a few iterations later.
+                lv32 = tree.leaf_value.astype(np.float32)
                 tree.apply_shrinkage(self._tree_shrinkage())
                 with global_tracer.span("train/update_score",
                                         block=lambda: self.scores):
@@ -1280,19 +1295,22 @@ class GBDT:
                         new_score_k = self.scores[k] + jnp.asarray(
                             vals.astype(np.float32))
                     else:
-                        leaf_vals = jnp.asarray(
-                            tree.leaf_value.astype(np.float32))
-                        new_score_k = self._update_score(self.scores[k],
-                                                         leaf_vals, row_leaf)
+                        new_score_k = self._slow_score_update(
+                            tree, lv32, row_leaf, k)
                     self.scores = self.scores.at[k].set(new_score_k)
                     self._update_valid_scores(tree, k)
                 if abs(self.init_scores[k]) > K_EPSILON and \
                         len(self.models) == 0:
                     tree.add_bias(self.init_scores[k])
             else:
-                # constant tree (ref: gbdt.cpp AsConstantTree)
-                if len(self.models) == 0:
-                    tree.leaf_value[:] = self.init_scores[k]
+                # constant tree (ref: gbdt.cpp AsConstantTree): bias on
+                # the first iteration, ZERO afterwards — the grower's
+                # unshrunk root output must not leak into the model (it
+                # was never added to the training scores, and a DART
+                # drop would subtract it; the fused path stores 0 for
+                # 1-leaf trees, asserted equal by TestFusedDart)
+                tree.leaf_value[:] = (self.init_scores[k]
+                                      if len(self.models) == 0 else 0.0)
             iter_trees.append(tree)
 
         self.models.append(iter_trees)
@@ -1318,6 +1336,16 @@ class GBDT:
 
     def _tree_shrinkage(self) -> float:
         return self.shrinkage_rate
+
+    def _slow_score_update(self, tree, lv32: np.ndarray, row_leaf, k):
+        """Slow-path score update, bit-aligned with the fused program:
+        f32 pre-shrinkage leaf values x f32 learning rate, multiplied
+        and added in one XLA program (see _update_score_shrunk). DART
+        overrides: its drop/re-add cycle subtracts f64 host leaf
+        values, so its slow path must add exactly those."""
+        return self._update_score_shrunk(
+            self.scores[k], jnp.asarray(lv32),
+            jnp.float32(self._tree_shrinkage()), row_leaf)
 
     # ------------------------------------------------------------------
     def add_valid(self, valid_set, raw_data: Optional[np.ndarray]) -> None:
@@ -1696,6 +1724,7 @@ class DART(GBDT):
         self._dart_fused = None      # jitted program
         self._dart_fast_disabled = False
         self._cur_shrinkage = float(config.learning_rate)
+        self._dart_update_score = None  # see _slow_score_update
 
     def init_from_loaded(self, loaded) -> None:
         super().init_from_loaded(loaded)
@@ -1709,6 +1738,25 @@ class DART(GBDT):
         # Normalize never rescales the new tree — and the bias of a
         # first tree is added AFTER this shrinkage (gbdt.cpp:426)
         return self._cur_shrinkage
+
+    def _slow_score_update(self, tree, lv32: np.ndarray, row_leaf, k):
+        # bit-aligned with the fused DART program's creation add
+        # (`scores_adj + old_factor*delta + new_factor*lv[row_leaf]`):
+        # PRE-shrinkage f32 leaf values, gathered FIRST, then multiplied
+        # by the f32 drop-factor and added in one XLA program — the same
+        # FMA-contraction shape, so drop-free iterations are bitwise
+        # identical between the paths. (The GBDT twin multiplies before
+        # the gather because ITS fused program does; the shapes must
+        # each match their own fused path, not each other.) Drop-cycle
+        # iterations still subtract/re-add f64 host leaf values and keep
+        # ulp-level drift — the multiclass knife-edge this kills is a
+        # split flip born in the drop-FREE early iterations.
+        if self._dart_update_score is None:
+            self._dart_update_score = jax.jit(
+                lambda score, lv, nf, rl: score + nf * lv[rl])
+        return self._dart_update_score(
+            self.scores[k], jnp.asarray(lv32),
+            jnp.float32(self._tree_shrinkage()), row_leaf)
 
     # -- fused path ----------------------------------------------------
     def _fast_path_ok(self, custom_grad) -> bool:
@@ -1866,9 +1914,15 @@ class DART(GBDT):
                         row_leaf.astype(hd))
                     lv_store = lv
                     if with_bias:
+                        # bias applies to 1-LEAF first-iteration trees
+                        # too: the reference's constant tree carries
+                        # leaf_value == init (AsConstantTree), and a
+                        # drop must subtract it — a class with (near-)
+                        # empty data keeps a 1-leaf tree whose bias the
+                        # history would otherwise lose (multiclass DART
+                        # parity, tests/test_engine.py)
                         lv_store = lv + jnp.where(
-                            (t_cur == 0) & (rec.num_leaves > 1),
-                            init_vec[k] / new_factor, 0.0)
+                            t_cur == 0, init_vec[k] / new_factor, 0.0)
                     leaf_vals = leaf_vals.at[t_cur, k].set(lv_store)
                     for vi in range(len(valid_bins)):
                         vleaf = replay_tree(
@@ -1981,14 +2035,19 @@ class DART(GBDT):
                 rec = {f: rec_all[f][k] for f in rec_all}
                 tree = Tree.from_arrays(rec, self.train_set.mappers,
                                         self.train_set.used_features)
-                if tree.num_leaves > 1:
-                    tree.apply_shrinkage(float(factors[i]))
+                if tree.num_leaves > 1 or first_iter:
+                    # constant FIRST-iteration trees rebuild from the
+                    # history buffer too: their bias rides it
+                    # (init/creation_factor), so factor x buffer
+                    # reproduces the reference's post-Normalize value
+                    # when the tree has been dropped/rescaled
+                    if tree.num_leaves > 1:
+                        tree.apply_shrinkage(float(factors[i]))
                     tree.leaf_value[:] = (
                         factors[i] * buf_vals[i][k][:len(tree.leaf_value)]
                     ).astype(tree.leaf_value.dtype)
                 else:
-                    tree.leaf_value[:] = (self.init_scores[k]
-                                          if first_iter else 0.0)
+                    tree.leaf_value[:] = 0.0
                 iter_trees.append(tree)
             if i < built:
                 self._host_models[base + i] = iter_trees

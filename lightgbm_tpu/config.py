@@ -186,6 +186,11 @@ _ALIASES: Dict[str, List[str]] = {
     # resilience knobs (resilience/ subsystem)
     "tpu_checkpoint_every": ["checkpoint_every", "checkpoint_freq"],
     "tpu_checkpoint_path": ["checkpoint_path", "checkpoint_file"],
+    "tpu_elastic_resume": ["elastic_resume"],
+    "tpu_continual_rounds": ["continual_rounds"],
+    "tpu_continual_retain": ["continual_retain", "continual_snapshots"],
+    "tpu_continual_eval_fraction": ["continual_eval_fraction"],
+    "tpu_continual_mode": ["continual_mode"],
     # serving knobs (serve/ subsystem)
     "serve_max_batch_rows": ["serve_max_batch"],
     "serve_max_wait_ms": ["serve_max_wait"],
@@ -590,6 +595,33 @@ class Config:
     # periodically.
     tpu_checkpoint_every: int = 0
     tpu_checkpoint_path: str = ""
+    # elastic resume (resilience/elastic.py): a checkpoint whose
+    # fingerprint differs from the rebuilt run in MESH SHAPE ONLY
+    # (tpu_num_shards drift — W-shard snapshot restored on a W'-shard
+    # mesh) is re-sharded through the rebuilt booster's sharding and
+    # admitted after a cross-shard drift-digest gate on the restored
+    # state (ElasticResumeError names any diverged shard before it
+    # votes). false = any fingerprint drift, mesh included, refuses
+    # with ResumeMismatchError. Structural drift (objective, dataset
+    # shape, tree counts) ALWAYS refuses.
+    tpu_elastic_resume: bool = True
+    # continual training (resilience/continual.py; lgb.continual_train).
+    # Each ingested chunk trains one GENERATION of tpu_continual_rounds
+    # extra iterations onto the long-lived model ("extend" mode;
+    # "refit" refreshes leaf values on the fresh chunk instead, decay
+    # refit_decay_rate). A held-out tpu_continual_eval_fraction slice
+    # of every chunk feeds the obs/health eval NaN/spike/plateau
+    # anomaly detector — the automatic accept-vs-rollback trigger; a
+    # rejected generation restores the last-good snapshot (bounded at
+    # tpu_continual_retain retained generations). Accepted generations
+    # hot-swap into the serve registry through the transactional
+    # validate-predict path with a bit-identical-on-reload assertion,
+    # so a rolled-back generation is never observable from the serve
+    # side. Exported as lgbmtpu_continual_* (obs/export.py).
+    tpu_continual_rounds: int = 10
+    tpu_continual_retain: int = 3
+    tpu_continual_eval_fraction: float = 0.2
+    tpu_continual_mode: str = "extend"
     # serving (serve/ async model server; task=serve and the in-process
     # API). Micro-batching: requests coalesce until serve_max_batch_rows
     # rows are pending or the OLDEST pending request has waited

@@ -269,6 +269,40 @@ def _scoped_telemetry_enable(callbacks) -> Callable[[], None]:
     return restore
 
 
+def continual_train(params: Dict[str, Any], chunks,
+                    num_features: Optional[int] = None,
+                    registry=None, serve_name: str = "continual",
+                    on_generation: Optional[Callable] = None):
+    """Continual-training entry point (resilience/continual.py): drive
+    one generation per ingested chunk through the long-lived
+    ``ContinualTrainer`` — ``init_model`` continuation (or refit),
+    eval-anomaly accept-vs-rollback, and validated hot-swap into
+    `registry` when given. `chunks` yields ``(X, y)`` or
+    ``(X, y, weight)``; `on_generation` (if given) is called with each
+    :class:`GenerationResult`. Returns the trainer (its ``booster()``
+    is the last-good model; ``summary()`` the lgbmtpu_continual_*
+    export payload). Knobs: ``tpu_continual_*``, ``tpu_elastic_resume``
+    and the PR-8 ``tpu_checkpoint_*`` family (a kill mid-generation
+    exits 75 and the re-run resumes that generation)."""
+    from .resilience.continual import ContinualTrainer
+    trainer = None
+    for chunk in chunks:
+        X, y = chunk[0], chunk[1]
+        w = chunk[2] if len(chunk) > 2 else None
+        if trainer is None:
+            nf = int(num_features if num_features is not None
+                     else np.atleast_2d(np.asarray(X)).shape[1])
+            trainer = ContinualTrainer(params, nf, registry=registry,
+                                       serve_name=serve_name)
+        trainer.push_rows(X, label=y, weight=w)
+        result = trainer.step()
+        if on_generation is not None:
+            on_generation(result)
+    if trainer is None:
+        raise ValueError("continual_train received no chunks")
+    return trainer
+
+
 class CVBooster:
     """Ensemble of per-fold boosters (ref: engine.py:299 CVBooster)."""
 

@@ -364,8 +364,36 @@ def _percentile_renew_traced(leaf_value, row_leaf, residual, weights, mask,
 
 def _renew_by_percentile(tree, residual, weights, row_leaf, sample_mask,
                          alpha):
-    """Set each leaf value to the weighted alpha-percentile of its residuals
-    (ref: RegressionL1loss::RenewTreeOutput)."""
+    """Set each leaf value to the weighted alpha-percentile of its
+    residuals (ref: RegressionL1loss::RenewTreeOutput).
+
+    Routed through ``_percentile_renew_traced`` — the SAME device
+    function the fused fast path runs — so the two paths cannot
+    disagree on knife-edge percentile picks. An f64 host loop
+    (``_weighted_percentile`` per leaf) and the f32 traced selection
+    round ``alpha * total_weight`` differently when it lands within an
+    ulp of a cumulative-weight step (e.g. alpha=0.7 over a leaf of 10
+    unit-weight rows: f64 says 7.000…001, f32 says 6.999…99 — an
+    off-by-one order-statistic pick that compounds through later
+    iterations). One implementation, two callers, zero cliffs;
+    tests/test_objectives.py keeps the traced selection within 1e-5 of
+    the f64 host oracle on non-degenerate fixtures."""
+    import jax.numpy as jnp
+    lv = _percentile_renew_traced(
+        jnp.asarray(np.asarray(tree.leaf_value, np.float32)),
+        jnp.asarray(np.asarray(row_leaf, np.int32)),
+        jnp.asarray(np.asarray(residual, np.float32)),
+        jnp.asarray(np.asarray(weights, np.float32)),
+        jnp.asarray(np.asarray(sample_mask, np.float32)), float(alpha))
+    tree.leaf_value = np.asarray(lv, np.float64).copy()
+    return tree
+
+
+def _renew_by_percentile_host(tree, residual, weights, row_leaf,
+                              sample_mask, alpha):
+    """The f64 host-loop oracle of `_renew_by_percentile` (per-leaf
+    ``_weighted_percentile``) — kept as the reference semantics the
+    traced selection is tested against."""
     sel = sample_mask > 0
     leaves = row_leaf[sel]
     res = residual[sel].astype(np.float64)

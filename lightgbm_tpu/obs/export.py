@@ -197,6 +197,32 @@ def render_openmetrics(registry=None,
         doc.sample("lgbmtpu_resilience_checkpoint_last_iteration",
                    "gauge", rc.get("last_iteration", -1))
 
+    # continual-training accounting (resilience/continual.py; the
+    # generation/rollback/swap COUNTS ride the generic continual/*
+    # counters above — these are the summary-shaped extras)
+    ct = meta.get("continual")
+    if isinstance(ct, dict) and "generations" in ct:
+        doc.sample("lgbmtpu_continual_swap_seconds_total", "counter",
+                   ct.get("swap_seconds_total", 0.0),
+                   help_text="wall time spent in validated hot-swaps "
+                             "(reload-parity check + transactional "
+                             "registry registration)")
+        doc.sample("lgbmtpu_continual_last_swap_seconds", "gauge",
+                   ct.get("last_swap_seconds", 0.0))
+        doc.sample("lgbmtpu_continual_model_iterations", "gauge",
+                   ct.get("model_iterations", 0),
+                   help_text="boosting iterations in the last-good "
+                             "continual model")
+        doc.sample("lgbmtpu_continual_retained_snapshots", "gauge",
+                   ct.get("retained_snapshots", 0))
+        doc.sample("lgbmtpu_continual_resumes_total", "counter",
+                   ct.get("resumes", 0),
+                   help_text="checkpoint resumes observed by the "
+                             "continual loop (incl. elastic mesh "
+                             "resizes, counted separately)")
+        doc.sample("lgbmtpu_continual_mesh_resizes_total", "counter",
+                   ct.get("mesh_resizes", 0))
+
     # XLA introspection (obs/xla.py; populated while enabled)
     from .xla import global_xla
     xs = global_xla.summary()

@@ -209,6 +209,16 @@ if global_metrics.enabled:
     global_xla.enable()
 
 
+def _persistent_cache_active() -> bool:
+    """True when the XLA persistent compilation cache is configured (via
+    ``jax.config`` or ``JAX_COMPILATION_CACHE_DIR``)."""
+    try:
+        import jax
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:
+        return bool(os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+
+
 def instrumented_jit(tag: str, fn: Callable, phase: Optional[str] = None,
                      registry: Optional[XlaIntrospector] = None,
                      **jit_kwargs) -> Callable:
@@ -219,6 +229,11 @@ def instrumented_jit(tag: str, fn: Callable, phase: Optional[str] = None,
     import jax
     from .health import global_health
     reg = registry if registry is not None else global_xla
+    if os.environ.get("LGBM_TPU_NO_DONATE") or _persistent_cache_active():
+        # Buffer donation segfaults on executables deserialized from the
+        # persistent compilation cache (jaxlib<=0.4.36); donation is a
+        # memory optimisation only, so drop it whenever the cache is on.
+        jit_kwargs.pop("donate_argnums", None)
     jitted = jax.jit(global_metrics.wrap_traced(tag, fn), **jit_kwargs)
     compiled_cache: Dict[Any, Any] = {}
     broken: List[str] = []  # non-empty => this tag fell back for good

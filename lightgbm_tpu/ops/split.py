@@ -25,6 +25,18 @@ from ..obs.metrics import global_metrics
 
 MISSING_NONE, MISSING_ZERO, MISSING_NAN = 0, 1, 2
 K_MIN_SCORE = -1e30
+# Tie-rejection band for the net-gain acceptance, relative to the
+# parent-gain shift. L1-family gradients are lattice-valued (e.g.
+# quantile: every grad is 1-alpha or -alpha), so candidate splits with
+# EXACTLY zero net improvement are structural, not rare — and f32
+# accumulation noise between two compilations of the same math (the
+# fused one-program iteration vs the standalone grower; XLA contracts
+# them differently) lands on either side of a strict `> 0` cut,
+# flipping whether a worthless split is made. Requiring the net gain to
+# clear a noise-sized band keeps both programs' verdicts identical on
+# structural ties while rejecting nothing a f32 pipeline could
+# meaningfully resolve (tests/test_engine.py::TestFusedRenewal).
+K_GAIN_TIE_RTOL = 1e-5
 K_EPSILON = 1e-15
 
 
@@ -347,7 +359,11 @@ def _gain_tensors(hist: jax.Array,
         )
         net = (gain * meta.penalty[:, None] - cegb_delta[:, None] - shift)
         net = jnp.where(mono_feat, net * mono_factor, net)
-        return jnp.where(valid, net, K_MIN_SCORE)
+        # structural-tie rejection (see K_GAIN_TIE_RTOL): a candidate
+        # must clear the f32 noise band of the gain arithmetic to count
+        # as an improvement at all
+        tie = K_GAIN_TIE_RTOL * jnp.maximum(jnp.abs(shift), 1.0)
+        return jnp.where(valid & (net > tie), net, K_MIN_SCORE)
 
     is_cat = meta.is_categorical[:, None]
     base_valid_a = (t_idx < nb - 1) & ~is_cat

@@ -15,15 +15,18 @@
 
 from .errors import (EXIT_PREEMPTED, CircuitOpenError,
                      CorruptCheckpointError, CorruptModelError,
-                     DeadlineExceeded, ResumeMismatchError,
-                     ServerOverloaded, TransientServeError)
+                     DeadlineExceeded, ElasticResumeError,
+                     ResumeMismatchError, ServerOverloaded,
+                     TransientServeError)
 from .faults import FaultPlan, global_faults, install as install_faults
 from .checkpoint import (load_checkpoint, restore_booster,
                          save_checkpoint)
+from .continual import ContinualTrainer, GenerationResult
 
 __all__ = [
-    "EXIT_PREEMPTED", "CircuitOpenError", "CorruptCheckpointError",
-    "CorruptModelError", "DeadlineExceeded", "ResumeMismatchError",
+    "EXIT_PREEMPTED", "CircuitOpenError", "ContinualTrainer",
+    "CorruptCheckpointError", "CorruptModelError", "DeadlineExceeded",
+    "ElasticResumeError", "GenerationResult", "ResumeMismatchError",
     "ServerOverloaded", "TransientServeError", "FaultPlan",
     "global_faults", "install_faults", "load_checkpoint",
     "restore_booster", "save_checkpoint",

@@ -55,7 +55,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .errors import CorruptCheckpointError, ResumeMismatchError
+from .errors import CorruptCheckpointError
 
 MAGIC = b"LGBMTPU-CKPT-v1\n"
 _FOOTER_TAG = b"\n#LGBMTPU-CKPT-SHA256:"
@@ -103,9 +103,18 @@ def _jnp_tree(obj):
 def _put_like(host: np.ndarray, like):
     """Device-put `host` with the sharding of the freshly-built `like`
     buffer — the restore path's answer to resized meshes: whatever
-    layout the rebuilt booster chose, the restored state follows."""
+    layout the rebuilt booster chose, the restored state follows.
+    An UNCOMMITTED `like` (e.g. valid scores, which every fresh run
+    holds as plain single-device arrays that jit replicates onto the
+    mesh at dispatch) must stay uncommitted: committing it to device 0
+    conflicts with the mesh-committed train state inside one program
+    ("incompatible devices for jitted computation" on elastic resume
+    with registered valid sets)."""
     import jax
     try:
+        if not getattr(like, "committed", True):
+            import jax.numpy as jnp
+            return jnp.asarray(np.asarray(host))
         return jax.device_put(np.asarray(host), like.sharding)
     except Exception:
         import jax.numpy as jnp
@@ -115,6 +124,7 @@ def _put_like(host: np.ndarray, like):
 # ---------------------------------------------------------------------------
 # capture
 def _fingerprint(gbdt) -> Dict[str, Any]:
+    from .elastic import mesh_shards_of
     return {
         "boosting_type": gbdt.boosting_type,
         "objective": getattr(gbdt.objective, "name", None),
@@ -123,6 +133,11 @@ def _fingerprint(gbdt) -> Dict[str, Any]:
         "num_tree_per_iteration": int(gbdt.num_tree_per_iteration),
         "num_leaves": int(gbdt.config.num_leaves),
         "num_valid_sets": len(gbdt._valid_sets),
+        # mesh width at snapshot time: the ONE key an elastic resume
+        # (resilience/elastic.py, tpu_elastic_resume) may tolerate
+        # drifting — a resized-mesh restore is a named event, not a
+        # silent accident
+        "mesh_shards": mesh_shards_of(gbdt),
     }
 
 
@@ -314,16 +329,15 @@ def restore_booster(booster, state: Dict[str, Any]) -> int:
     """Install `state` into a freshly-constructed Booster (same params,
     same train/valid data, possibly a different mesh size). Returns the
     iteration to resume from."""
+    from . import elastic
     gbdt = booster._gbdt
     if gbdt is None:
         raise ValueError("resume requires a training booster")
-    fp_now, fp_ck = _fingerprint(gbdt), state["fingerprint"]
-    if fp_now != fp_ck:
-        diffs = {k: (fp_ck.get(k), fp_now.get(k)) for k in fp_ck
-                 if fp_ck.get(k) != fp_now.get(k)}
-        raise ResumeMismatchError(
-            f"checkpoint is incompatible with this run: {diffs} "
-            "(checkpoint value, current value)")
+    # structural drift always refuses; mesh-shape drift alone is an
+    # elastic resume when tpu_elastic_resume allows it
+    resized = elastic.check_fingerprint(
+        state["fingerprint"], _fingerprint(gbdt),
+        elastic.elastic_enabled(gbdt.config))
 
     gbdt._host_models = list(state["trees"])
     gbdt._device_records = []
@@ -350,35 +364,12 @@ def restore_booster(booster, state: Dict[str, Any]) -> int:
     booster.best_score = dict(state["best_score"])
     gbdt._fused = None  # rebuild against the restored buffers
 
-    _validate_restored_replicas(gbdt)
-    from ..obs.metrics import global_metrics
-    global_metrics.inc_counter("resilience/resumes")
+    # rejoin gate (resilience/elastic.py): digest-validate the restored
+    # state across the (possibly resized) mesh BEFORE the first resumed
+    # iteration votes; a diverged shard raises ElasticResumeError.
+    # Also counts resilience/resumes (+ mesh_resizes when resized).
+    elastic.gate_rejoin(gbdt, state, resized=resized)
     return gbdt.iter
-
-
-def _validate_restored_replicas(gbdt) -> None:
-    """On a multi-device mesh with tpu_health armed, digest-compare the
-    restored replicated score state across shards BEFORE the rejoined
-    replica contributes an iteration — a torn restore (one host read a
-    stale checkpoint) fails fast as a structured DriftError instead of
-    silently forking the model."""
-    mesh = getattr(gbdt, "_shard_mesh", None) or getattr(gbdt, "mesh",
-                                                         None)
-    if mesh is None or getattr(mesh, "size", 1) <= 1:
-        return
-    if not getattr(gbdt, "_health_armed", False):
-        return
-    from ..obs import health as obs_health
-    from ..parallel.mesh import is_replicated_on
-    import jax
-    arrays = {}
-    if isinstance(gbdt.scores, jax.Array) and \
-            is_replicated_on(mesh, gbdt.scores):
-        arrays["restored_scores"] = gbdt.scores
-    if arrays:
-        obs_health.global_health.check_drift(
-            mesh, arrays, mode=gbdt._health_mode,
-            where="checkpoint restore")
 
 
 def try_load(path: str) -> Optional[Dict[str, Any]]:

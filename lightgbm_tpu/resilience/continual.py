@@ -1,0 +1,410 @@
+"""Elastic continual training: a long-lived model that keeps learning.
+
+Production traffic drifts; a model trained once decays. This module
+composes the pieces earlier PRs built in isolation into the online loop
+ROADMAP item 4 asks for:
+
+- **Ingest** — fresh rows arrive in chunks through
+  ``io/streaming.DatasetBuilder`` (``push_rows``), the same
+  copy-on-finalize contract the distributed ingestion path uses.
+- **Extend** — each :meth:`step` trains one GENERATION: the pushed
+  chunk becomes a Dataset, a held-out tail slice becomes the
+  generation's eval set, and ``engine.train`` continues the long-lived
+  model via ``init_model`` continuation (``tpu_continual_mode=extend``)
+  or refreshes leaf values on the fresh chunk via ``refit.py``
+  (``refit``).
+- **Accept vs rollback** — every per-iteration eval result feeds the
+  obs/health.py NaN/spike/plateau anomaly detector (ONE detector whose
+  history spans generations, so a quality regression versus the
+  previous generation registers as a spike). A generation that raises a
+  rollback-class anomaly is REJECTED: the last-good snapshot stays the
+  model, the rollback counter ticks, and nothing reaches serving. A
+  bounded deque retains the last ``tpu_continual_retain`` accepted
+  snapshots for operator-driven :meth:`rollback`.
+- **Validated hot-swap** — an ACCEPTED generation is re-parsed from its
+  serialized bytes, asserted bit-identical to the training booster on a
+  probe slice (reload parity), and only then registered into the serve
+  ``ModelRegistry`` through the transactional validate-predict path —
+  a rejected generation is never observable from the serve side, and a
+  reload-parity failure rejects the generation too.
+
+Preemption interplay (PR 8): with ``tpu_checkpoint_path`` set, each
+generation checkpoints under ``<path>.gen<G>`` — a kill mid-generation
+exits 75 and re-running :meth:`step` with the same pushed chunk resumes
+that generation (elastically, if the mesh was resized in between:
+resilience/elastic.py). Everything is exported as
+``lgbmtpu_continual_*`` (obs/export.py) and summarized in
+``bench.py --continual``.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+# eval anomaly kinds that reject a generation by default; "plateau" is
+# informational (fresh data legitimately stops helping) unless opted in
+DEFAULT_ROLLBACK_ON = ("nan", "spike")
+
+
+class GenerationResult:
+    """Outcome of one continual generation (one :meth:`step`)."""
+
+    __slots__ = ("generation", "accepted", "reason", "anomalies",
+                 "eval_history", "rounds", "model_iterations",
+                 "train_seconds", "swap_seconds", "resumed")
+
+    def __init__(self, generation: int, accepted: bool, reason: str,
+                 anomalies: Dict[str, int],
+                 eval_history: List[tuple], rounds: int,
+                 model_iterations: int, train_seconds: float,
+                 swap_seconds: float, resumed: bool):
+        self.generation = generation
+        self.accepted = accepted
+        self.reason = reason
+        self.anomalies = anomalies
+        self.eval_history = eval_history
+        self.rounds = rounds
+        self.model_iterations = model_iterations
+        self.train_seconds = train_seconds
+        self.swap_seconds = swap_seconds
+        self.resumed = resumed
+
+    def __repr__(self) -> str:  # operator-friendly one-liner
+        verdict = "accepted" if self.accepted else \
+            f"ROLLED BACK ({self.reason})"
+        return (f"<generation {self.generation}: {verdict}, "
+                f"{self.model_iterations} total iterations>")
+
+
+class ContinualTrainer:
+    """The long-lived continual-training driver (``lgb.continual_train``
+    wraps it; tools/check_continual.py chaos-tests it)."""
+
+    def __init__(self, params: Dict[str, Any], num_features: int,
+                 registry=None, serve_name: str = "continual",
+                 rollback_on=DEFAULT_ROLLBACK_ON,
+                 probe_rows: int = 16):
+        from ..config import Config
+        self.params = dict(params or {})
+        self.num_features = int(num_features)
+        cfg = Config.from_params(self.params)
+        self.rounds = max(int(cfg.tpu_continual_rounds), 1)
+        self.retain = max(int(cfg.tpu_continual_retain), 1)
+        self.eval_fraction = min(max(
+            float(cfg.tpu_continual_eval_fraction), 0.0), 0.9)
+        mode = str(cfg.tpu_continual_mode).lower()
+        if mode not in ("extend", "refit"):
+            raise ValueError(
+                f"tpu_continual_mode={cfg.tpu_continual_mode!r} is not "
+                "one of extend/refit")
+        self.mode = mode
+        self.refit_decay = float(cfg.refit_decay_rate)
+        self._ckpt_base = str(cfg.tpu_checkpoint_path or "")
+        self.registry = registry
+        self.serve_name = str(serve_name)
+        self.rollback_on = tuple(rollback_on)
+        self.probe_rows = max(int(probe_rows), 1)
+
+        # ONE anomaly detector across generations: its eval history is
+        # what makes "worse than the last few generations" a spike
+        from ..obs.health import HealthRegistry
+        self._detector = HealthRegistry()
+
+        self._model_str: Optional[str] = None      # last-good snapshot
+        self._retained = deque(maxlen=self.retain)  # (gen, model_str)
+        self._builder = None
+        self.generation = 0        # attempts (accepted + rolled back)
+        self.accepted = 0
+        self.rollbacks = 0
+        self.swaps = 0
+        self.swap_seconds_total = 0.0
+        self.last_swap_seconds = 0.0
+        self.train_seconds_total = 0.0
+        self.model_iterations = 0
+        self.history: List[GenerationResult] = []
+        self._publish()
+
+    # ------------------------------------------------------------------
+    # ingestion (io/streaming.py)
+    def push_rows(self, data, label, weight=None) -> "ContinualTrainer":
+        """Buffer one fresh chunk for the next generation (chunked-push
+        contract of ``io/streaming.DatasetBuilder``)."""
+        if self._builder is None:
+            from ..io.streaming import DatasetBuilder
+            self._builder = DatasetBuilder(self.num_features,
+                                           params=self._data_params())
+        self._builder.push_rows(data, label=label, weight=weight)
+        return self
+
+    def _data_params(self) -> Dict[str, Any]:
+        # binning/dataset params only — engine knobs ride self.params
+        keep = {k: v for k, v in self.params.items()
+                if k in ("max_bin", "min_data_in_bin", "categorical_feature",
+                         "feature_pre_filter", "bin_construct_sample_cnt")}
+        return keep
+
+    @property
+    def pending_rows(self) -> int:
+        return self._builder.num_pushed if self._builder is not None else 0
+
+    # ------------------------------------------------------------------
+    def step(self) -> GenerationResult:
+        """Train one generation on everything pushed since the last
+        step; accept (and hot-swap) or roll back. Raises if no rows are
+        pending."""
+        if self._builder is None or self._builder.num_pushed == 0:
+            raise ValueError("no rows pushed for this generation "
+                             "(call push_rows first)")
+        builder, self._builder = self._builder, None
+        ds = builder.finalize()
+        probe = np.asarray(ds.data, np.float64)[:self.probe_rows]
+
+        gen = self.generation
+        t0 = time.perf_counter()
+        if self.mode == "refit" and self._model_str is not None:
+            bst, eval_hist, resumed = self._refit_generation(ds)
+        else:
+            n = ds.num_data()
+            cut = n - int(round(n * self.eval_fraction))
+            cut = min(max(cut, 1), n)
+            dtrain = ds.subset(np.arange(cut)) if cut < n else ds
+            dvalid = ds.subset(np.arange(cut, n)) if cut < n else None
+            bst, eval_hist, resumed = self._train_generation(
+                gen, dtrain, dvalid)
+        train_s = time.perf_counter() - t0
+
+        # -- accept-vs-rollback: feed the detector, collect fresh flags
+        flags: Dict[str, int] = {}
+        for (it, data_name, metric, value, hib) in eval_hist:
+            for f in self._detector.note_eval(it, data_name, metric,
+                                              value, hib):
+                flags[f] = flags.get(f, 0) + 1
+        reason = next((f for f in self.rollback_on if f in flags), "")
+
+        swap_s = 0.0
+        if not reason:
+            model_str = bst.model_to_string()
+            swap_err = self._hot_swap(model_str, probe, bst)
+            if swap_err:
+                reason = swap_err
+            else:
+                swap_s = self.last_swap_seconds
+                self._model_str = model_str
+                self._retained.append((gen, model_str))
+                self.accepted += 1
+                self.model_iterations = bst.current_iteration()
+
+        self.generation += 1
+        self.train_seconds_total += train_s
+        accepted = not reason
+        if not accepted:
+            self.rollbacks += 1
+        from ..obs.metrics import global_metrics
+        global_metrics.inc_counter("continual/generations")
+        global_metrics.inc_counter("continual/accepted" if accepted
+                                   else "continual/rollbacks")
+        result = GenerationResult(
+            generation=gen, accepted=accepted, reason=reason,
+            anomalies=flags, eval_history=eval_hist, rounds=self.rounds,
+            model_iterations=self.model_iterations,
+            train_seconds=train_s, swap_seconds=swap_s, resumed=resumed)
+        self.history.append(result)
+        self._publish()
+        if not accepted:
+            from .. import log
+            log.warning(
+                f"continual generation {gen} ROLLED BACK ({reason}): "
+                f"model stays at the last-good snapshot "
+                f"({self.model_iterations} iterations); serve registry "
+                "untouched")
+        return result
+
+    # ------------------------------------------------------------------
+    def _train_generation(self, gen: int, dtrain, dvalid):
+        """One init_model-continuation generation; returns
+        (booster, eval_history, resumed)."""
+        from .. import callback as callback_mod
+        from ..engine import train as engine_train
+        from ..obs.metrics import global_metrics
+
+        eval_hist: List[tuple] = []
+
+        def record_evals(env) -> None:
+            for item in (env.evaluation_result_list or ()):
+                eval_hist.append((env.iteration, item[0], item[1],
+                                  float(item[2]), bool(item[3])))
+        record_evals.needs_eval = True
+        record_evals.order = 30
+
+        params = dict(self.params)
+        params["verbosity"] = params.get("verbosity", -1)
+        ckpt = ""
+        if self._ckpt_base:
+            # per-generation checkpoint: a kill mid-generation resumes
+            # THIS generation; a stale path from an earlier generation
+            # must never fingerprint-collide with this chunk's shapes
+            ckpt = f"{self._ckpt_base}.gen{gen}"
+            params["tpu_checkpoint_path"] = ckpt
+        resumes_before = int(global_metrics.counters.get(
+            "resilience/resumes", 0))
+        init_model = None
+        if self._model_str is not None:
+            # engine.train treats a str init_model as a FILENAME; the
+            # retained snapshot is serialized bytes — parse them here
+            from ..model_io import load_model_from_string
+            init_model = load_model_from_string(self._model_str)
+        bst = engine_train(
+            params, dtrain, num_boost_round=self.rounds,
+            valid_sets=[dvalid] if dvalid is not None else None,
+            valid_names=["continual_eval"] if dvalid is not None else None,
+            init_model=init_model,
+            callbacks=[record_evals])
+        resumed = int(global_metrics.counters.get(
+            "resilience/resumes", 0)) > resumes_before
+        if ckpt:
+            import os
+            try:  # the generation completed; its checkpoint is spent
+                os.remove(ckpt)
+            except OSError:
+                pass
+        return bst, eval_hist, resumed
+
+    def _refit_generation(self, ds):
+        """Refit mode: keep tree structures, refresh leaf values on the
+        fresh chunk (refit.py), then eval once for the detector."""
+        from ..basic import Booster
+        from ..refit import refit_booster
+        base = Booster(model_str=self._model_str)
+        X = np.asarray(ds.data, np.float64)
+        y = np.asarray(ds.label, np.float32)
+        bst = refit_booster(base, X, y, decay_rate=self.refit_decay)
+        # one summary eval on the refit chunk feeds the detector: raw-
+        # score RMSE against the labels — not a proper likelihood for
+        # every objective, but finite, consistent across generations,
+        # and NaN exactly when the refit leaves went non-finite
+        pred = np.asarray(bst.predict(X, raw_score=True), np.float64)
+        rmse = float(np.sqrt(np.mean(
+            (pred.reshape(len(y), -1)[:, 0] - y) ** 2)))
+        eval_hist = [(self.generation, "continual_eval", "refit_rmse",
+                      rmse, False)]
+        return bst, eval_hist, False
+
+    # ------------------------------------------------------------------
+    # validated hot-swap
+    def _hot_swap(self, model_str: str, probe: np.ndarray,
+                  bst) -> str:
+        """Register an accepted generation for serving. Returns "" on
+        success, or a rejection reason. Order matters: the reload-parity
+        assertion runs BEFORE the registry is touched, and registration
+        itself is transactional (serve/registry.py) — a failure at any
+        point leaves the previous generation fully served."""
+        t0 = time.perf_counter()
+        from ..model_io import load_model_from_string
+        try:
+            reloaded = load_model_from_string(model_str)
+        except Exception as exc:
+            from .. import log
+            log.warning(f"continual hot-swap: reload failed: {exc!r}")
+            return "reload_error"
+        if probe is not None and len(probe) and reloaded.trees:
+            direct = np.asarray(bst.predict(probe, raw_score=True))
+            served = reloaded.predict(probe, raw_score=True)
+            served = np.asarray(served)
+            if direct.shape != served.shape or \
+                    not np.array_equal(direct, served):
+                from ..obs.metrics import global_metrics
+                global_metrics.inc_counter("continual/swap_mismatches")
+                return "reload_mismatch"
+        if self.registry is not None:
+            try:
+                self.registry.load(self.serve_name, model=reloaded,
+                                   validate=True)
+            except Exception as exc:
+                from .. import log
+                log.warning(f"continual hot-swap: transactional "
+                            f"registration failed: {exc!r}")
+                return "swap_error"
+        dt = time.perf_counter() - t0
+        self.swaps += 1
+        self.swap_seconds_total += dt
+        self.last_swap_seconds = dt
+        from ..obs.metrics import global_metrics
+        global_metrics.inc_counter("continual/swaps")
+        global_metrics.note_latency("continual/swap", dt)
+        return ""
+
+    # ------------------------------------------------------------------
+    def rollback(self) -> bool:
+        """Operator rollback: discard the newest retained snapshot and
+        reinstall (and re-serve) the one before it. False when no older
+        snapshot is retained. Transactional: the re-serve registration
+        runs FIRST (serve/registry.py's load is itself transactional),
+        so a failed re-serve leaves the trainer AND the registry on the
+        current generation — training and serving never point at
+        different generations."""
+        if len(self._retained) < 2:
+            return False
+        gen, model_str = self._retained[-2]
+        if self.registry is not None:
+            self.registry.load(self.serve_name, model_str=model_str,
+                               validate=True)
+        self._retained.pop()
+        self._model_str = model_str
+        self.model_iterations = self._iterations_of(model_str)
+        self.rollbacks += 1
+        from ..obs.metrics import global_metrics
+        global_metrics.inc_counter("continual/rollbacks")
+        self._publish()
+        return True
+
+    @staticmethod
+    def _iterations_of(model_str: str) -> int:
+        from ..model_io import load_model_from_string
+        return load_model_from_string(model_str).num_iterations
+
+    @property
+    def model_str(self) -> Optional[str]:
+        """The last-good serialized model (what serving sees)."""
+        return self._model_str
+
+    def booster(self):
+        """The last-good snapshot as a Booster (prediction-only)."""
+        from ..basic import Booster
+        if self._model_str is None:
+            raise ValueError("no accepted generation yet")
+        return Booster(model_str=self._model_str)
+
+    # ------------------------------------------------------------------
+    def summary(self) -> Dict[str, Any]:
+        """The ``lgbmtpu_continual_*`` exporter's source of truth (also
+        folded into ``bench.py --continual``'s JSON line)."""
+        from .elastic import resume_summary
+        out: Dict[str, Any] = {
+            "generations": self.generation,
+            "accepted": self.accepted,
+            "rollbacks": self.rollbacks,
+            "swaps": self.swaps,
+            "swap_seconds_total": round(self.swap_seconds_total, 6),
+            "last_swap_seconds": round(self.last_swap_seconds, 6),
+            "train_seconds_total": round(self.train_seconds_total, 6),
+            "model_iterations": self.model_iterations,
+            "retained_snapshots": len(self._retained),
+            "rounds_per_generation": self.rounds,
+            "mode": self.mode,
+        }
+        rs = resume_summary()
+        if rs:
+            out["resumes"] = rs.get("resumes", 0)
+            out["mesh_resizes"] = rs.get("mesh_resizes", 0)
+        anomalies = dict(self._detector.eval_anomalies)
+        if anomalies:
+            out["eval_anomalies"] = anomalies
+        return out
+
+    def _publish(self) -> None:
+        from ..obs.metrics import global_metrics
+        global_metrics.set_meta("continual", self.summary())

@@ -1,0 +1,188 @@
+"""Elastic mesh-resize resume: restore a checkpoint taken on W shards
+onto a W'-shard mesh, with the rejoin validated before it votes.
+
+PR 8's checkpoint/resume restores sharded [N] row state through the
+*rebuilt* booster's sharding (``jax.device_put(host, like.sharding)``),
+so the mechanics of landing W-shard state on a W'-shard mesh already
+exist. What was missing is the *policy* and the *safety net*:
+
+- **Policy** — the checkpoint fingerprint now records ``mesh_shards``.
+  ``check_fingerprint`` tolerates a fingerprint that differs in mesh
+  shape ONLY (and only when ``tpu_elastic_resume`` is on); any other
+  structural drift — objective, dataset shape, tree counts — raises
+  ``ResumeMismatchError`` exactly as before. An elastic resume is a
+  deliberate, named event (``resilience/elastic_resumes`` /
+  ``resilience/mesh_resizes`` counters), not a silent accident.
+
+- **Safety net** — before the first resumed iteration contributes, the
+  rejoined replicas are gated with obs/health.py drift digests
+  (``gate_rejoin``): a compact host-side digest of the restored row
+  state (scores, bagging mask, valid scores, iteration counter) is
+  replicated onto the rebuilt mesh and digest-compared per shard,
+  together with any restored state that is genuinely replicated on the
+  mesh. In a multi-process elastic rejoin each process computes the
+  digest from the checkpoint IT loaded — a shard that read a stale or
+  torn container diverges here and the resume fails fast with
+  ``ElasticResumeError`` naming the shard ordinal(s), instead of
+  silently forking the model on the first psum.
+
+The deterministic chaos twin is ``resize_at_iter`` in
+resilience/faults.py: kill at iteration k, re-run with a different
+``tpu_num_shards``, and this module proves the rejoin
+(tools/check_continual.py drives it end-to-end).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .errors import ElasticResumeError, ResumeMismatchError
+
+# the only fingerprint keys an elastic resume may tolerate drifting —
+# everything else is structural and always refuses
+MESH_KEYS = ("mesh_shards",)
+
+
+def mesh_shards_of(gbdt) -> int:
+    """The booster's mesh width (1 for the serial/unsharded path)."""
+    mesh = getattr(gbdt, "_shard_mesh", None)
+    if mesh is None:
+        mesh = getattr(gbdt, "mesh", None)
+    return int(getattr(mesh, "size", 1) or 1) if mesh is not None else 1
+
+
+def fingerprint_diffs(fp_ck: Dict[str, Any],
+                      fp_now: Dict[str, Any]) -> Dict[str, tuple]:
+    """{key: (checkpoint value, current value)} over keys that differ.
+    A key absent from the CHECKPOINT fingerprint is skipped (an older
+    container written before that key existed cannot be blamed for it);
+    a key absent from the current fingerprint still reports."""
+    return {k: (fp_ck.get(k), fp_now.get(k)) for k in fp_ck
+            if fp_ck.get(k) != fp_now.get(k)}
+
+
+def check_fingerprint(fp_ck: Dict[str, Any], fp_now: Dict[str, Any],
+                      elastic: bool) -> bool:
+    """Validate a checkpoint fingerprint against the freshly-built run.
+    Returns True when this is a (tolerated) mesh resize; raises
+    ``ResumeMismatchError`` on any structural drift, and on mesh drift
+    too when ``elastic`` is off."""
+    diffs = fingerprint_diffs(fp_ck, fp_now)
+    if not diffs:
+        return False
+    structural = {k: v for k, v in diffs.items() if k not in MESH_KEYS}
+    if structural:
+        raise ResumeMismatchError(
+            f"checkpoint is incompatible with this run: {structural} "
+            "(checkpoint value, current value)")
+    if not elastic:
+        raise ResumeMismatchError(
+            f"checkpoint was taken on a different mesh shape: {diffs} "
+            "(checkpoint value, current value); set "
+            "tpu_elastic_resume=true to resume across a mesh resize")
+    return True
+
+
+# ---------------------------------------------------------------------------
+# rejoin validation
+def restore_digest(state: Dict[str, Any]) -> np.ndarray:
+    """Compact [8] f32 digest of the row state a checkpoint restores:
+    iteration counter, score sum/sumsq/abs-sum (nonfinite zeroed, like
+    the obs/health drift digests), bagging-mask sum, valid-score sum,
+    tree count, nonfinite count. Computed on HOST from the loaded
+    container — in a multi-process rejoin, each process digests what it
+    actually read, so a stale/torn load diverges at the gate."""
+    scores = np.asarray(state.get("scores", np.zeros(1)), np.float64)
+    finite = np.isfinite(scores)
+    sz = np.where(finite, scores, 0.0)
+    mask = np.asarray(state.get("sample_mask", np.zeros(1)), np.float64)
+    vsum = float(sum(
+        np.where(np.isfinite(v), np.asarray(v, np.float64), 0.0).sum()
+        for v in state.get("valid_scores", ())))
+    return np.asarray([
+        float(state.get("iteration", -1)),
+        sz.sum(), (sz * sz).sum(), np.abs(sz).sum(),
+        float((~finite).sum()),
+        float(np.where(np.isfinite(mask), mask, 0.0).sum()),
+        vsum,
+        float(len(state.get("trees", ()))),
+    ], np.float32)
+
+
+def gate_rejoin(gbdt, state: Dict[str, Any], *,
+                resized: bool = False) -> None:
+    """Digest-validate the restored state across the (possibly resized)
+    mesh BEFORE the first resumed iteration votes. Single-device meshes
+    return immediately; a diverged shard raises ``ElasticResumeError``
+    naming its ordinal(s). Also counts the resume/resize events the
+    continual exporter publishes (``lgbmtpu_continual_*``)."""
+    from ..obs.metrics import global_metrics
+    global_metrics.inc_counter("resilience/resumes")
+    if resized:
+        global_metrics.inc_counter("resilience/mesh_resizes")
+        global_metrics.inc_counter("resilience/elastic_resumes")
+    mesh = getattr(gbdt, "_shard_mesh", None)
+    if mesh is None:
+        mesh = getattr(gbdt, "mesh", None)
+    if mesh is None or getattr(mesh, "size", 1) <= 1:
+        return
+    import jax
+
+    from ..obs import health as obs_health
+    from ..obs.health import DriftError
+    from ..parallel.mesh import is_replicated_on, replicate
+
+    arrays: Dict[str, Any] = {
+        # the host-loaded container's digest, replicated: every shard
+        # must have restored from the SAME bytes
+        "restore_digest": replicate(mesh, restore_digest(state)),
+    }
+    # restored buffers that are genuinely replicated on this mesh
+    # (voting / feature-parallel learners replicate scores) are
+    # digest-compared directly — a torn device_put fails here
+    if isinstance(gbdt.scores, jax.Array) and \
+            is_replicated_on(mesh, gbdt.scores):
+        arrays["restored_scores"] = gbdt.scores
+    if isinstance(getattr(gbdt, "_sample_mask", None), jax.Array) and \
+            is_replicated_on(mesh, gbdt._sample_mask):
+        arrays["restored_sample_mask"] = gbdt._sample_mask
+    try:
+        obs_health.global_health.check_drift(
+            mesh, arrays, mode="error",
+            where="elastic rejoin" if resized else "checkpoint restore")
+    except DriftError as exc:
+        shards = _diverged_shards(obs_health.global_health)
+        global_metrics.inc_counter("resilience/elastic_gate_failures")
+        raise ElasticResumeError(
+            f"elastic resume rejected: restored state diverged across "
+            f"the rebuilt mesh (shard(s) {shards}) — {exc}",
+            shards=shards) from exc
+
+
+def _diverged_shards(health) -> List[int]:
+    last = getattr(health, "last_drift", None) or {}
+    shards: List[int] = []
+    for m in last.get("mismatches", ()):
+        for s in m.get("shards", ()):
+            if s not in shards:
+                shards.append(int(s))
+    return shards
+
+
+def elastic_enabled(config) -> bool:
+    v = getattr(config, "tpu_elastic_resume", True)
+    return str(v).lower() not in ("off", "0", "false", "none", "")
+
+
+def resume_summary() -> Optional[Dict[str, int]]:
+    """The resume/resize counter snapshot bench and the continual
+    exporter fold into their summaries; None when nothing resumed."""
+    from ..obs.metrics import global_metrics
+    out = {k.rsplit("/", 1)[1]: int(v)
+           for k, v in global_metrics.counters.items()
+           if k in ("resilience/resumes", "resilience/mesh_resizes",
+                    "resilience/elastic_resumes",
+                    "resilience/elastic_gate_failures")}
+    return out or None

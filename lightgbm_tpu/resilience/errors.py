@@ -49,7 +49,20 @@ class CorruptCheckpointError(CorruptModelError):
 
 class ResumeMismatchError(ValueError):
     """A checkpoint exists but was written by an incompatible run
-    (different objective / tree counts / dataset shape)."""
+    (different objective / tree counts / dataset shape). Mesh-shape
+    drift alone is tolerated when ``tpu_elastic_resume`` is on
+    (resilience/elastic.py); everything else always refuses."""
+
+
+class ElasticResumeError(RuntimeError):
+    """An elastic (mesh-resized) resume failed its rejoin validation:
+    the drift digests of the restored state did not agree across the
+    rebuilt mesh, so letting the rejoined replicas vote would fork the
+    model. ``shards`` names the diverged shard ordinals."""
+
+    def __init__(self, message: str, shards: Optional[list] = None):
+        self.shards = list(shards or [])
+        super().__init__(message)
 
 
 class DeadlineExceeded(RuntimeError):

@@ -8,6 +8,10 @@ the REAL production code paths — no monkeypatched shortcuts:
 - ``kill_at_iter=k`` — engine.train treats the boundary after iteration
   k exactly like a SIGTERM: finish the iteration, snapshot, exit with
   ``EXIT_PREEMPTED``.
+- ``resize_at_iter=k`` — the same boundary preemption, counted as a
+  *resize* event: the supervisor re-runs the command with a different
+  ``tpu_num_shards`` and the elastic resume path (resilience/elastic.py)
+  restores the checkpoint onto the resized mesh.
 - ``corrupt_checkpoint_byte=off`` — after a checkpoint lands on disk,
   flip the byte at offset ``off`` of the payload (validates that the
   digest footer rejects it on load).
@@ -41,7 +45,7 @@ from typing import Any, Dict, Optional
 
 from .errors import TransientServeError
 
-_INT_KEYS = {"kill_at_iter", "corrupt_checkpoint_byte",
+_INT_KEYS = {"kill_at_iter", "resize_at_iter", "corrupt_checkpoint_byte",
              "poison_labels_at_iter", "registry_load_failures",
              "serve_predict_failures", "slow_shard"}
 _FLOAT_KEYS = {"slow_iter_ms", "serve_slow_ms"}
@@ -53,6 +57,7 @@ class FaultPlan:
 
     def __init__(self, **kwargs: Any) -> None:
         self.kill_at_iter: Optional[int] = None
+        self.resize_at_iter: Optional[int] = None
         self.corrupt_checkpoint_byte: Optional[int] = None
         self.poison_labels_at_iter: Optional[int] = None
         self.slow_iter_ms: float = 0.0
@@ -117,12 +122,22 @@ class FaultPlan:
     # -- hooks (each called from exactly one production site) ----------
     def kill_now(self, iteration: int) -> bool:
         """True at the boundary after `iteration` when the plan says to
-        simulate preemption there (once)."""
-        if self.kill_at_iter is None or iteration != self.kill_at_iter:
-            return False
-        self.kill_at_iter = None  # one shot — the resumed run survives
-        self._note("kill")
-        return True
+        simulate preemption there (once). ``resize_at_iter`` is the same
+        engine-boundary preemption, noted as a *resize* event: the
+        supervisor (tools/check_continual.py, tests) re-runs the command
+        on a different ``tpu_num_shards`` so kill -> resume-on-resized-
+        mesh is a deterministic chaos scenario."""
+        if self.kill_at_iter is not None and \
+                iteration == self.kill_at_iter:
+            self.kill_at_iter = None  # one shot — the resumed run
+            self._note("kill")        # survives
+            return True
+        if self.resize_at_iter is not None and \
+                iteration == self.resize_at_iter:
+            self.resize_at_iter = None
+            self._note("resize")
+            return True
+        return False
 
     def maybe_corrupt_checkpoint(self, path: str) -> bool:
         """Flip one payload byte of the checkpoint just written."""

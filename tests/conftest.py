@@ -15,6 +15,22 @@ import jax  # noqa: E402
 # the axon TPU plugin overrides JAX_PLATFORMS; force CPU explicitly
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache, keyed on HLO: every Booster builds
+# fresh jit partials, so identical programs recompile once per TEST
+# without it. The disk cache dedupes them within one pytest run (the
+# in-memory jit cache is per-callable and can't) and across runs — a
+# warm cache cuts JAX-heavy files by ~40-50% (measured on
+# test_quantized: 75s cold/uncached -> 39s warm), which is what lets
+# the full tier-1 sweep fit its timeout. Opt out: LGBM_TPU_NO_JAX_CACHE=1.
+if not os.environ.get("LGBM_TPU_NO_JAX_CACHE"):
+    import tempfile
+    _cache_dir = os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "lgbm-tpu-jax-cache"))
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

@@ -4,6 +4,7 @@ round-trips (ref: monotone_constraints.hpp, tree.h:375 categorical
 bitset decisions)."""
 
 import numpy as np
+import pytest
 
 import lightgbm_tpu as lgb
 
@@ -13,6 +14,7 @@ def _train(X, y, params, rounds=30):
     return lgb.train(dict(params), ds, num_boost_round=rounds)
 
 
+@pytest.mark.slow
 def test_monotone_grid_deep_tree_conflicting_interactions():
     """y depends on x0 through a sign-flipping interaction (x0*x1): an
     unconstrained model is non-monotone in x0; with monotone +1 on x0
@@ -72,6 +74,7 @@ def _monotone_fixture(seed=0, n=4000):
     return X, y, rng
 
 
+@pytest.mark.slow
 def test_monotone_methods_grid():
     """intermediate/advanced (exact pairwise leaf-box bounds, ref:
     monotone_constraints.hpp:517,859) must stay strictly monotone on
@@ -89,6 +92,7 @@ def test_monotone_methods_grid():
             assert v <= 1e-6, (method, wave, v)
 
 
+@pytest.mark.slow
 def test_monotone_intermediate_less_constraining_than_basic():
     """The reference's selling point for intermediate/advanced: much
     less constraining than basic, so the constrained fit recovers more

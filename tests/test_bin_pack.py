@@ -190,6 +190,7 @@ def test_packed_partition_bit_parity():
 # ---------------------------------------------------------------------------
 # end-to-end training parity
 # ---------------------------------------------------------------------------
+@pytest.mark.slow
 def test_packed_training_bit_identical():
     """tpu_bin_pack=auto (packed) vs off (uint8 oracle): the full waved
     training loop must produce bit-identical models — the packed layout
@@ -202,6 +203,7 @@ def test_packed_training_bit_identical():
     assert m_on == m_off
 
 
+@pytest.mark.slow
 def test_packed_training_bit_identical_quantized():
     """The acceptance fixture: quantized gradients + packed bins vs the
     unpacked oracle — bit-identical (int32 histogram sums are exact)."""
@@ -213,6 +215,7 @@ def test_packed_training_bit_identical_quantized():
     assert m_on == m_off
 
 
+@pytest.mark.slow
 def test_packed_2bit_training():
     """max_bin=3 engages the 2-bit pair layout end to end."""
     X, y = _binary(2000)
@@ -268,6 +271,7 @@ def test_fused_grad_bit_identical_binary():
     assert m_on == m_off
 
 
+@pytest.mark.slow
 def test_fused_grad_bit_identical_weighted_regression():
     r = np.random.RandomState(1)
     n = 2500
@@ -285,6 +289,7 @@ def test_fused_grad_bit_identical_weighted_regression():
     assert outs["auto"] == outs["off"]
 
 
+@pytest.mark.slow
 def test_fused_grad_in_kernel_pallas_bit_identical():
     """The pallas path computes gradients INSIDE the multi kernel
     (interpret mode on CPU): must bit-match the pre-built-ghT pallas
@@ -520,6 +525,7 @@ def test_int8_xla_matches_pallas_bitwise():
     np.testing.assert_array_equal(np.asarray(d), np.asarray(x))
 
 
+@pytest.mark.slow
 def test_quantized_waved_runs_int8_on_xla():
     """use_quantized_grad on the default (XLA) backend now runs the
     exact-integer int8 histogram — same int32 sums as the device kernel

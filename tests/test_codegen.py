@@ -156,6 +156,7 @@ def test_aggregate_matches_engine_binary(tmp_path):
     np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_aggregate_matches_engine_multiclass(tmp_path):
     x, _ = _data(n=400)
     rng = np.random.RandomState(3)

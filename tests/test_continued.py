@@ -55,7 +55,7 @@ def test_resume_from_file(tmp_path):
 
 
 def test_resume_multiclass():
-    X, y = make_multiclass(900)
+    X, y = make_multiclass(600)
     params = {"objective": "multiclass", "num_class": 4, "num_leaves": 15,
               "verbosity": -1}
     b = lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=3)

@@ -251,6 +251,7 @@ def test_mesh_quantized_reduce_is_integer_typed():
         f"expected i32 all_reduce reductions, got {ar_types}"
 
 
+@pytest.mark.slow
 def test_mono_pairwise_parallel_learners_match_serial():
     """monotone_constraints_method=advanced under all three parallel
     learners (VERDICT r4 #7): the pairwise leaf-box state is replicated

@@ -116,13 +116,13 @@ class TestBinary:
 
 class TestMulticlass:
     def test_softmax(self):
-        X, y = make_multiclass(1500, k=4)
+        X, y = make_multiclass(900, k=4)
         Xt, yt, Xv, yv = _split(X, y)
         dtrain = lgb.Dataset(Xt, label=yt)
         bst = lgb.train({"objective": "multiclass", "num_class": 4,
                          "num_leaves": 15, "min_data_in_leaf": 5,
                          "verbosity": -1},
-                        dtrain, num_boost_round=30)
+                        dtrain, num_boost_round=18)
         pred = bst.predict(Xv)
         assert pred.shape == (len(Xv), 4)
         np.testing.assert_allclose(pred.sum(1), 1.0, rtol=1e-5)

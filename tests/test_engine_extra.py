@@ -4,6 +4,7 @@ stopping min_delta, unseen categoricals, importance types, init_score
 continuation (ref: tests/python_package_test/test_engine.py)."""
 
 import numpy as np
+import pytest
 
 from conftest import make_binary, make_multiclass, make_regression
 
@@ -60,6 +61,7 @@ class TestEarlyStoppingMinDelta:
                                           verbose=False)])
         return bst.best_iteration
 
+    @pytest.mark.slow
     def test_min_delta_stops_earlier(self):
         """A large min_delta must stop no later than min_delta=0
         (ref: callback.py early_stopping min_delta)."""
@@ -283,6 +285,7 @@ def test_cat_l2_regularizes_categorical_gain():
     assert cat_splits1 < cat_splits0
 
 
+@pytest.mark.slow
 def test_min_sum_hessian_in_leaf_limits_leaves():
     """min_sum_hessian_in_leaf blocks low-mass leaves (ref:
     feature_histogram.hpp min_sum_hessian check)."""

@@ -410,6 +410,7 @@ class TestOpenMetricsAndTools:
         assert global_health.summary() == {}
         assert "lgbmtpu_health_" not in render_openmetrics()
 
+    @pytest.mark.slow
     def test_check_health_tool(self):
         import check_health
         assert check_health.main() == 0

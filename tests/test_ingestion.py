@@ -159,6 +159,7 @@ def test_arrow_vector():
 
 
 # ----------------------------------------------------------------------
+@pytest.mark.slow
 def test_streaming_builder_matches_monolithic():
     X, y = make_binary(600, 5)
     w = np.abs(np.random.RandomState(0).randn(600)) + 0.5

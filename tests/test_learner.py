@@ -216,6 +216,7 @@ class TestGrowTree:
         # depth <= 2 means at most 4 leaves
         assert int(rec.num_leaves) <= 4
 
+    @pytest.mark.slow
     def test_leaf_counts_sum_to_n(self):
         n = 600
         rng = np.random.RandomState(10)

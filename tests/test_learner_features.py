@@ -143,6 +143,7 @@ class TestInteractionConstraints:
 
 
 class TestPathSmoothing:
+    @pytest.mark.slow
     def test_smoothing_shrinks_leaf_values(self):
         X, y = make_regression(500, 6)
         b0 = _train(X, y, {}, rounds=5)

@@ -172,10 +172,11 @@ def test_rank_xendcg_gradients_sum_zero_per_query():
 @pytest.mark.parametrize("alpha", [0.1, 0.5, 0.9])
 @pytest.mark.parametrize("weighted", [False, True])
 def test_percentile_renew_traced_matches_host(alpha, weighted):
-    """The fused fast path's traced percentile renewal must agree with the
-    host `_renew_by_percentile` twin on identical inputs."""
+    """The traced percentile renewal (now the ONE implementation both
+    the fused and host paths run) must agree with the f64 host-loop
+    oracle `_renew_by_percentile_host` on identical inputs."""
     from lightgbm_tpu.objectives import (_percentile_renew_traced,
-                                         _renew_by_percentile)
+                                         _renew_by_percentile_host)
     from lightgbm_tpu.tree import Tree
     rng = np.random.RandomState(7)
     n, L = 500, 8
@@ -187,8 +188,8 @@ def test_percentile_renew_traced_matches_host(alpha, weighted):
     tree = Tree(L)
     tree.leaf_value = rng.randn(L)
     orig_empty = float(tree.leaf_value[L - 1])
-    host = _renew_by_percentile(tree, residual.astype(np.float64), weights,
-                                row_leaf, mask, alpha)
+    host = _renew_by_percentile_host(
+        tree, residual.astype(np.float64), weights, row_leaf, mask, alpha)
     dev = np.asarray(_percentile_renew_traced(
         jnp.zeros(L, jnp.float32).at[L - 1].set(orig_empty),
         jnp.asarray(row_leaf), jnp.asarray(residual), jnp.asarray(weights),

@@ -77,6 +77,7 @@ class TestTraversalParity:
             np.asarray(predict_raw_multiclass(ens, xb)),
             np.asarray(predict_raw_scan(ens, xb)))
 
+    @pytest.mark.slow
     def test_multiclass_single_program_bit_identical(self):
         x, _ = _data(n=600)
         rng = np.random.RandomState(3)

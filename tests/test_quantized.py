@@ -3,6 +3,7 @@ config.h use_quantized_grad / num_grad_quant_bins /
 quant_train_renew_leaf / stochastic_rounding)."""
 
 import numpy as np
+import pytest
 
 from conftest import make_binary, make_regression
 
@@ -40,6 +41,7 @@ class TestQuantizedTraining:
         ss_tot = ((y - y.mean()) ** 2).sum()
         assert 1 - ss_res / ss_tot > 0.8
 
+    @pytest.mark.slow
     def test_more_bins_is_closer_to_full(self):
         X, y = make_regression(1500, 8, seed=2)
 
@@ -56,6 +58,7 @@ class TestQuantizedTraining:
         assert q16 < full * 1.5
         assert q4 < full * 2.5
 
+    @pytest.mark.slow
     def test_deterministic_rounding_mode(self):
         X, y = make_regression(800, 6)
         p = {"objective": "regression", "verbosity": -1,
@@ -72,6 +75,7 @@ class TestQuantizedTraining:
                         lgb.Dataset(X, label=y), num_boost_round=20)
         assert _auc(y, bst.predict(X)) > 0.8
 
+    @pytest.mark.slow
     def test_quantized_multiclass(self):
         from conftest import make_multiclass
         X, y = make_multiclass(1200, 8, k=4)

@@ -272,6 +272,7 @@ class TestServerParity:
         np.testing.assert_array_equal(b, direct.predict(x[:40],
                                                         raw_score=True))
 
+    @pytest.mark.slow
     def test_multiclass_parity(self):
         x, _ = _data(n=600, nans=False)
         rng = np.random.RandomState(3)

@@ -80,6 +80,7 @@ def test_classifier_label_mapping():
     assert acc > 0.8
 
 
+@pytest.mark.slow
 def test_classifier_multiclass():
     X, y = _make_multiclass()
     m = LGBMClassifier(n_estimators=15, num_leaves=15)

@@ -94,6 +94,7 @@ def test_sparse_storage_matches_dense_binning():
         np.testing.assert_array_equal(bs.bins_fm, bd.bins_fm)
 
 
+@pytest.mark.slow
 def test_sparse_train_matches_dense():
     """CSR training must reach the same quality as dense training on
     the same data (VERDICT r3 'done' criterion)."""
@@ -221,6 +222,7 @@ def test_sparse_categorical_matches_dense():
                                rtol=1e-5, atol=1e-7)
 
 
+@pytest.mark.slow
 def test_coo_input_and_cv():
     x, xd, y = _sparse_binary(n=600, f=10)
     coo = x.tocoo()

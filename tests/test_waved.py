@@ -64,6 +64,7 @@ def test_waved_default_is_auto():
     assert bsto._gbdt._use_waved()
 
 
+@pytest.mark.slow
 def test_waved_quality_parity_binary():
     X, y = make_binary(4000)
     auc_exact = _auc(y, _train(X, y, 0).predict(X))
@@ -74,6 +75,7 @@ def test_waved_quality_parity_binary():
     assert auc_waved > 0.9
 
 
+@pytest.mark.slow
 def test_waved_quality_parity_regression():
     # held-out comparison: exact leaf-wise overfits deeper at equal
     # rounds, so train-set error would mis-rank the growers
@@ -108,6 +110,7 @@ def test_waved_first_splits_match_exact():
     assert first_split(m_exact) == first_split(m_waved)
 
 
+@pytest.mark.slow
 def test_waved_categorical():
     r = np.random.RandomState(7)
     n = 3000
@@ -129,6 +132,7 @@ def test_waved_categorical():
     np.testing.assert_allclose(loaded.predict(X), bst.predict(X), rtol=1e-9)
 
 
+@pytest.mark.slow
 def test_waved_monotone():
     r = np.random.RandomState(3)
     n = 3000
@@ -273,6 +277,7 @@ def test_apply_wave_splits_matches_sequential():
                                       np.asarray(batched))
 
 
+@pytest.mark.slow
 def test_batched_partition_through_grower_with_bundle():
     """Force the batched wave partition (the TPU default) through the
     FULL waved grower on CPU, on EFB-bundled one-hot data, and require

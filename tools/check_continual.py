@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""Chaos validator for elastic continual training (ISSUE 12).
+
+Drives the REAL code paths end-to-end with the deterministic fault
+plan (lightgbm_tpu/resilience/faults.py) — the acceptance scenario of
+the elastic-continual PR, kept honest in CI:
+
+1. **Kill -> resume on a RESIZED mesh** — ``resize_at_iter`` preempts
+   a 1-shard run at iteration k (exit 75); the re-run restores the
+   checkpoint onto a 2-shard mesh through the drift-validated rejoin
+   (resilience/elastic.py gate_rejoin), and the finished model's
+   predictions are bit-identical to the never-preempted run. The
+   resize is observed as a counted event (``resilience/mesh_resizes``).
+2. **Poisoned generation -> automatic rollback, serve isolation** —
+   a continual loop over fresh chunks accepts a healthy generation
+   into a live ``ModelRegistry``, then ingests a poisoned chunk
+   (NaN labels -> NaN eval) and a quality-regressed chunk (labels
+   blown up -> eval spike): BOTH are rolled back by the eval anomaly
+   gate, the registry still serves the exact last-good entry (the
+   rejected generations were never observable from the serve side),
+   and a healthy follow-up chunk extends the last-good model.
+3. **Live /metrics scrape** — against the server wrapping that same
+   registry: every ``lgbmtpu_continual_*`` family is present in a real
+   HTTP scrape and the document passes the OpenMetrics lint
+   (tools/check_metrics_endpoint.py).
+
+Exit 0 = all steps passed. Wired into the quick verification tier via
+tests/test_resilience.py (TestToolsWiring).
+"""
+
+import os
+import sys
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def _fixture(n=264, f=6, seed=3):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = (X[:, 0] * 2.0 - X[:, 1] + 0.1 * r.randn(n)).astype(np.float32)
+    return X, y
+
+
+def step1_resize_resume(tmpdir) -> None:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.resilience import faults as fm
+    from lightgbm_tpu.resilience.errors import EXIT_PREEMPTED
+
+    r = np.random.RandomState(0)
+    X = r.randn(264, 6)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.4).astype(np.float32)
+    ck = os.path.join(tmpdir, "resize.ckpt")
+    params = {"objective": "binary", "num_leaves": 7, "verbosity": -1,
+              "tpu_checkpoint_path": ck, "tpu_num_shards": 1}
+    straight = lgb.train(dict(params), lgb.Dataset(X, y),
+                         num_boost_round=8)
+    p_straight = straight.predict(X)
+    if os.path.exists(ck):  # only written if a snapshot knob fired
+        os.remove(ck)
+
+    fm.install(fm.FaultPlan(resize_at_iter=3))
+    try:
+        lgb.train(dict(params), lgb.Dataset(X, y), num_boost_round=8)
+        raise AssertionError("injected resize preemption did not exit")
+    except SystemExit as e:
+        assert e.code == EXIT_PREEMPTED, \
+            f"resize preemption exit code {e.code} != {EXIT_PREEMPTED}"
+    finally:
+        fm.reset()
+    assert os.path.exists(ck), "resize preemption left no checkpoint"
+
+    before = int(global_metrics.counters.get("resilience/mesh_resizes",
+                                             0))
+    resized = dict(params, tpu_num_shards=2)
+    resumed = lgb.train(dict(resized), lgb.Dataset(X, y),
+                        num_boost_round=8)
+    assert resumed.current_iteration() == 8
+    # quality parity with the unresized run: the sharded histogram
+    # reduce carries ulp-level f32 ordering noise across mesh widths,
+    # so the contract is the mesh-parity tolerance the distributed
+    # suite pins (tests/test_distributed.py), not bit equality
+    np.testing.assert_allclose(resumed.predict(X), p_straight,
+                               rtol=1e-4, atol=1e-4)
+    resizes = int(global_metrics.counters.get("resilience/mesh_resizes",
+                                              0)) - before
+    assert resizes == 1, \
+        f"mesh resize not counted as an event (delta {resizes})"
+    print("# step 1 OK: kill@3 -> resume on 2-shard mesh -> "
+          "drift-validated rejoin, quality parity with the unresized "
+          "run, resize counted")
+
+
+def step2_rollback_isolation(registry) -> "object":
+    import lightgbm_tpu as lgb
+
+    params = {"objective": "regression", "num_leaves": 7, "metric": "l2",
+              "verbosity": -1, "tpu_continual_rounds": 4,
+              "tpu_continual_eval_fraction": 0.25}
+    trainer = lgb.ContinualTrainer(params, num_features=6,
+                                   registry=registry, serve_name="m")
+
+    X0, y0 = _fixture(seed=0)
+    r0 = trainer.push_rows(X0, label=y0).step()
+    assert r0.accepted, "healthy generation was rejected"
+    served = registry.get("m")
+    probe = X0[:8]
+    p_good = served.predict_raw(probe)
+
+    # NaN labels -> NaN held-out eval -> "nan" rollback
+    X1, y1 = _fixture(seed=1)
+    r1 = trainer.push_rows(X1, label=y1 * np.nan).step()
+    assert not r1.accepted and r1.reason == "nan", \
+        f"NaN generation not rolled back ({r1.reason!r})"
+    # labels blown up -> eval spike vs cross-generation history
+    X2, y2 = _fixture(seed=2)
+    r2 = trainer.push_rows(X2, label=y2 * 1000.0).step()
+    assert not r2.accepted and r2.reason == "spike", \
+        f"regressed generation not rolled back ({r2.reason!r})"
+
+    # the serve side never saw either rejected generation
+    assert registry.get("m") is served, \
+        "registry entry was replaced by a rejected generation"
+    assert np.array_equal(served.predict_raw(probe), p_good), \
+        "served predictions changed after rejected generations"
+    assert trainer.model_iterations == 4, \
+        "last-good model did not stand after rollbacks"
+
+    # a healthy chunk extends the LAST-GOOD model and hot-swaps
+    X3, y3 = _fixture(seed=5)
+    r3 = trainer.push_rows(X3, label=y3).step()
+    assert r3.accepted and trainer.model_iterations == 8
+    assert registry.get("m") is not served, \
+        "accepted generation did not hot-swap"
+    s = trainer.summary()
+    assert (s["generations"], s["rollbacks"]) == (4, 2), s
+    print("# step 2 OK: NaN + spike generations rolled back, serve "
+          "registry never exposed them, healthy generation extended "
+          "last-good and hot-swapped")
+    return trainer
+
+
+CONTINUAL_FAMILIES = (
+    "lgbmtpu_continual_generations_total",
+    "lgbmtpu_continual_accepted_total",
+    "lgbmtpu_continual_rollbacks_total",
+    "lgbmtpu_continual_swaps_total",
+    "lgbmtpu_continual_swap_seconds_total",
+    "lgbmtpu_continual_last_swap_seconds",
+    "lgbmtpu_continual_model_iterations",
+    "lgbmtpu_continual_retained_snapshots",
+    "lgbmtpu_continual_resumes_total",
+    "lgbmtpu_continual_mesh_resizes_total",
+)
+
+
+def step3_metrics_scrape(registry) -> None:
+    import asyncio
+
+    from lightgbm_tpu.serve.server import ModelServer
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import check_metrics_endpoint
+
+    async def run() -> str:
+        srv = ModelServer(registry)
+        ep = srv.start_metrics_endpoint(0)
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ep.port}/metrics",
+                    timeout=5) as resp:
+                return resp.read().decode()
+        finally:
+            await srv.close()
+
+    text = asyncio.run(run())
+    errors, families = check_metrics_endpoint.validate_exposition(text)
+    assert not errors, f"OpenMetrics lint errors: {errors[:5]}"
+    missing = [f for f in CONTINUAL_FAMILIES if f not in families]
+    assert not missing, f"missing lgbmtpu_continual_* families: {missing}"
+    print(f"# step 3 OK: live /metrics scrape carries all "
+          f"{len(CONTINUAL_FAMILIES)} lgbmtpu_continual_* families "
+          "(lint clean)")
+
+
+def main() -> int:
+    import tempfile
+
+    from lightgbm_tpu.serve.registry import ModelRegistry
+    with tempfile.TemporaryDirectory() as tmpdir:
+        step1_resize_resume(tmpdir)
+        registry = ModelRegistry()
+        trainer = step2_rollback_isolation(registry)
+        # step 1's resume counters fold into the continual summary the
+        # exporter publishes — refresh it before the scrape
+        trainer._publish()
+        step3_metrics_scrape(registry)
+    print("# continual chaos validator OK (3/3 steps)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

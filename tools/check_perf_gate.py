@@ -2,7 +2,7 @@
 """Perf-regression gate (ROADMAP item 4: convert "should be fast" into
 driver-visible proof).
 
-Seven checks, all against the recorded floor in tools/perf_floor.json:
+Eight checks, all against the recorded floor in tools/perf_floor.json:
 
 1. **Histogram traffic model** — recomputes the static per-iteration
    HBM byte model (learner.hist_traffic_model) for the recorded
@@ -65,6 +65,14 @@ Seven checks, all against the recorded floor in tools/perf_floor.json:
    wall-time must stay under the floor-configured ceiling — fault
    tolerance is only free if the snapshots are. Graceful skip when no
    checkpointing ran (the common bench config).
+
+8. **Continual-loop overhead** — over the latest bench record carrying
+   a ``continual`` summary (bench.py --continual,
+   resilience/continual.py): the validated hot-swap share of continual
+   wall-time and the total non-training overhead share must stay under
+   the floor-configured caps — a long-lived model is only viable if
+   accepting a generation is nearly free. Graceful skip when no
+   continual bench ran.
 
 Exit 0 = gate passed; exit 1 = regression, with one line per failure.
 Wired into the quick verification tier via tests/test_perf_gate.py.
@@ -437,6 +445,87 @@ def check_resilience_overhead(floor, failures, lines):
               f"{n} snapshot(s) (ceiling {max_share:.0%})")
 
 
+def _load_continual_records(candidate_path=None):
+    """[(tag, record)] for every bench line carrying a `continual`
+    summary (bench.py --continual), oldest first; candidate last."""
+    out = []
+    paths = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
+    if candidate_path and os.path.exists(candidate_path):
+        paths.append(candidate_path)
+    for path in paths:
+        try:
+            with open(path) as fh:
+                blob = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        rec = None
+        if isinstance(blob.get("continual"), dict):
+            rec = blob
+        else:
+            for line in reversed(str(blob.get("tail", "")).splitlines()):
+                line = line.strip()
+                if line.startswith("{") and '"continual"' in line:
+                    try:
+                        cand = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(cand.get("continual"), dict):
+                        rec = cand
+                        break
+        if rec is not None:
+            out.append((os.path.basename(path), rec))
+    return out
+
+
+def check_continual_overhead(floor, failures, candidate_path=None):
+    """Continual-loop overhead ceilings (check 8): over the latest
+    bench record carrying a `continual` summary (bench.py --continual),
+    the validated hot-swap share of continual wall-time and the total
+    non-training overhead share (swap + rollback/snapshot bookkeeping +
+    ingest) must stay under the floor-configured caps — a long-lived
+    model is only viable if accepting a generation is nearly free.
+    No continual bench recorded => the check reports itself skipped."""
+    cfg = floor.get("continual")
+    if not cfg:
+        print("# no continual floor recorded; continual-overhead "
+              "check skipped")
+        return
+    recs = _load_continual_records(candidate_path)
+    if not recs:
+        print("# no continual bench recorded; continual-overhead "
+              "check skipped")
+        return
+    tag, rec = recs[-1]
+    ct = rec["continual"]
+    wall = float(ct.get("wall_seconds", 0.0))
+    gens = int(ct.get("generations", 0))
+    if wall <= 0.0 or gens <= 0:
+        print(f"# continual[{tag}]: no generations recorded; "
+              "continual-overhead check skipped")
+        return
+    swap_share = float(ct.get("swap_share",
+                              float(ct.get("swap_seconds_total", 0.0))
+                              / wall))
+    overhead_share = float(ct.get("overhead_seconds", 0.0)) / wall
+    max_swap = float(cfg.get("max_swap_share", 0.10))
+    max_overhead = float(cfg.get("max_overhead_share", 0.25))
+    if swap_share > max_swap:
+        failures.append(
+            f"{tag}: hot-swap share {swap_share:.2%} of continual "
+            f"wall-time over {gens} generation(s) exceeds the "
+            f"{max_swap:.0%} ceiling")
+    if overhead_share > max_overhead:
+        failures.append(
+            f"{tag}: non-training overhead share {overhead_share:.2%} "
+            f"of continual wall-time (swap + rollback + ingest) "
+            f"exceeds the {max_overhead:.0%} ceiling")
+    if swap_share <= max_swap and overhead_share <= max_overhead:
+        print(f"# continual[{tag}]: swap share {swap_share:.2%}, "
+              f"overhead share {overhead_share:.2%} over {gens} "
+              f"generation(s), {int(ct.get('rollbacks', 0))} "
+              f"rollback(s) (ceilings {max_swap:.0%}/{max_overhead:.0%})")
+
+
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
@@ -492,6 +581,7 @@ def main(argv=None) -> int:
     check_phase_trajectory(floor, failures, lines)
     check_health_summaries(floor, failures, lines)
     check_resilience_overhead(floor, failures, lines)
+    check_continual_overhead(floor, failures, candidate)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")
