@@ -57,7 +57,7 @@ BASELINE_IPS = 500.0 / 130.094  # reference CPU Higgs-10.5M iters/sec
 RELAY_PORTS = (8082, 8083, 8087)
 
 
-_BENCH_MODES = ("train", "predict", "serve", "continual")
+_BENCH_MODES = ("train", "predict", "serve", "continual", "stream")
 
 
 def parse_bench_mode(argv=None, environ=None) -> str:
@@ -179,16 +179,18 @@ def _replay_child_stderr(path: str) -> None:
 
 
 _MODE_DEFAULT_ROWS = {"train": 10_500_000, "predict": 8_000_000,
-                      "serve": 2_000_000, "continual": 2_000_000}
+                      "serve": 2_000_000, "continual": 2_000_000,
+                      "stream": 10_500_000}
 # CPU-fallback shard sizes: the 1-core host must finish in budget (see
 # the fallback comment below); inference modes keep more rows than
 # training, and --serve pays per-request scheduling on top of traversal
 _MODE_CPU_ROWS = {"train": 50_000, "predict": 300_000, "serve": 150_000,
-                  "continual": 40_000}
+                  "continual": 40_000, "stream": 50_000}
 _MODE_METRIC = {"train": "boosting_iters_per_sec_higgs_shape",
                 "predict": "predict_rows_per_sec",
                 "serve": "serve_rows_per_sec",
-                "continual": "continual_rows_per_sec"}
+                "continual": "continual_rows_per_sec",
+                "stream": "stream_rows_per_sec"}
 
 
 def main():
@@ -843,8 +845,111 @@ def _measure_continual():
           f"{record['continual']['swap_share']:.2%}", file=sys.stderr)
 
 
+def _measure_stream():
+    """Out-of-core streaming bench (tpu_stream, io/streaming.py +
+    learner.StreamTreeGrower): trains the SAME Higgs-shaped fixture
+    twice — resident (the anchor) and forced-streaming with a
+    multi-slab plan — and emits streamed rows/sec, slab upload vs
+    kernel wall seconds, the measured `stream_overlap_ratio` (fraction
+    of upload time issued while device compute was in flight), and
+    `vs_resident` (resident wall / streamed wall; perf-gate check 9
+    holds the slowdown to the recorded ceiling)."""
+    n = int(os.environ.get("BENCH_ROWS", 10_500_000))
+    f = 28
+    iters = int(os.environ.get("BENCH_ITERS", 10))
+    warmup = 2
+
+    import jax
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.io.streaming import global_stream_stats
+    from lightgbm_tpu.ops.bin_pack import slab_align
+
+    platform = jax.default_backend()
+    if platform == "cpu":
+        iters = min(iters, int(os.environ.get("BENCH_CPU_ITERS", 3)))
+        warmup = 1
+    rng = np.random.RandomState(0)
+    x = rng.randn(n, f).astype(np.float32)
+    logit = (x[:, 0] + 0.6 * x[:, 1] ** 2 + 0.4 * x[:, 2] * x[:, 3]
+             - 0.3 * np.abs(x[:, 4]) + 0.5 * rng.randn(n))
+    y = (logit > 0.2).astype(np.float32)
+
+    base_params = {"objective": "binary", "num_leaves": 255,
+                   "learning_rate": 0.1, "max_bin": 63,
+                   "min_sum_hessian_in_leaf": 100, "min_data_in_leaf": 0,
+                   "verbosity": -1}
+
+    def timed_train(extra):
+        params = dict(base_params, **extra)
+        ds = lgb.Dataset(x, label=y, params=params)
+        ds.construct()
+        bst = lgb.Booster(params, ds)
+        for _ in range(warmup):
+            bst.update()
+        jax.block_until_ready(bst._gbdt.scores)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            bst.update()
+        _ = np.asarray(bst._gbdt.scores[0, :8])  # host-transfer block
+        return bst, time.perf_counter() - t0
+
+    # resident anchor (same shape, same iteration count, same run) —
+    # tpu_stream pinned OFF so a capacity-constrained host can't
+    # silently stream the anchor and gate streaming against itself.
+    # The anchor booster is dropped before the streamed half runs: its
+    # device-resident bins/scores must not occupy the HBM the streamed
+    # measurement is supposed to have free.
+    anchor, resident_wall = timed_train({"tpu_stream": "off"})
+    del anchor
+
+    # forced streaming with a REAL multi-slab plan: ~4 slabs (or the
+    # smallest aligned slab when the fixture is tiny)
+    align = slab_align(int(base_params["max_bin"]))
+    slab_rows = max(align, (n // 4) // align * align)
+    global_stream_stats.reset()
+    bst, stream_wall = timed_train({"tpu_stream": "on",
+                                    "tpu_stream_slab_rows": slab_rows})
+    stats = global_stream_stats.summary()
+    plan = bst._gbdt._stream
+
+    rows_per_sec = n * iters / stream_wall
+    record = {
+        "metric": "stream_rows_per_sec",
+        "value": round(rows_per_sec, 3),
+        "unit": f"boosted rows/sec (n={n}, 255 leaves, 63 bins, "
+                f"{plan.n_slabs} slabs, platform={platform})",
+        "vs_baseline": round(resident_wall / stream_wall, 4),
+        "stream": dict(
+            stats,
+            slab_rows=int(plan.slab_rows),
+            n_slabs=int(plan.n_slabs),
+            stream_overlap_ratio=stats["overlap_ratio"],
+            upload_seconds=stats["upload_seconds_total"],
+            kernel_seconds=stats["kernel_seconds_total"],
+            stream_wall_seconds=round(stream_wall, 3),
+            resident_wall_seconds=round(resident_wall, 3),
+            vs_resident=round(resident_wall / stream_wall, 4),
+        ),
+    }
+    out = os.environ.get("BENCH_OUT")
+    line = json.dumps(record)
+    if out:
+        with open(out, "w") as fh:
+            fh.write(line + "\n")
+    else:
+        print(line, flush=True)
+    print(f"# stream: {plan.n_slabs} slab(s) x {plan.slab_rows} rows, "
+          f"overlap={stats['overlap_ratio']:.2%}, "
+          f"upload={stats['upload_seconds_total']:.2f}s "
+          f"kernel={stats['kernel_seconds_total']:.2f}s, "
+          f"resident {resident_wall:.2f}s vs streamed "
+          f"{stream_wall:.2f}s", file=sys.stderr)
+
+
 _MODE_MEASURE = {"train": _measure, "predict": _measure_predict,
-                 "serve": _measure_serve, "continual": _measure_continual}
+                 "serve": _measure_serve, "continual": _measure_continual,
+                 "stream": _measure_stream}
 
 
 def _emit_partial_obs(mode: str, exc) -> None:

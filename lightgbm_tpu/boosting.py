@@ -121,13 +121,24 @@ class GBDT:
         # device tensor ships packed and every histogram/partition
         # consumer unpacks on the fly — the packed bytes are what each
         # of the ~13 per-iteration full-data passes actually reads.
+        # Out-of-core streaming (tpu_stream, io/streaming.py): the bin
+        # tensor instead stays HOST-resident as section-aligned slabs
+        # and `bins_fm` is the HostSlabBins plan — the streamed growers
+        # feed it to the device wave-by-wave, double-buffered.
         self._bin_pack_vpb = 1
-        packed = self._maybe_pack_bins(train_set)
-        if packed is not None:
-            self.bins_fm = packed
-            self._bin_pack_vpb = packed.vpb
+        self._stream = self._resolve_stream(train_set)
+        self._stream_progs: Dict = {}
+        self._stream_next_bins = None  # cross-iteration upload prefetch
+        if self._stream is not None:
+            self.bins_fm = self._stream
+            self._bin_pack_vpb = self._stream.vpb
         else:
-            self.bins_fm = train_set.device_bins()
+            packed = self._maybe_pack_bins(train_set)
+            if packed is not None:
+                self.bins_fm = packed
+                self._bin_pack_vpb = packed.vpb
+            else:
+                self.bins_fm = train_set.device_bins()
         # EFB (ref: dataset.cpp:251): bins_fm is bundled [G, N] storage;
         # the growers decode through this triple (None when unbundled)
         self._bundle = train_set.device_bundle()
@@ -286,6 +297,91 @@ class GBDT:
                                  int(binned.max_bins))
         return bp.to_device(host) if host is not None else None
 
+    def _stream_ineligible(self, train_set) -> Optional[str]:
+        """Why out-of-core streaming cannot serve this configuration,
+        or None when it can: the shared config-level gate list
+        (obs/memory.stream_config_ineligible — the same predicate
+        preflight's recommendation screens with) plus the storage-level
+        gates only a constructed dataset knows. The streamed grower is
+        the waved grower's twin over dense (optionally packed)
+        serial/data-parallel storage; everything else keeps the
+        resident paths."""
+        if train_set.bundle_info is not None:
+            return "EFB-bundled storage is not slab-sliceable"
+        if train_set.sparse_coo is not None:
+            return "COO sparse storage streams by nnz, not row slabs"
+        from .obs import memory as obs_memory
+        return obs_memory.stream_config_ineligible(
+            self.config, num_class=self.num_tree_per_iteration)
+
+    def _resolve_stream(self, train_set):
+        """Resolve ``tpu_stream`` into a ``HostSlabBins`` plan or None.
+
+        auto: stream only when the analytic memory model says resident
+        training does NOT fit device capacity (ROADMAP item 1's
+        "recommend streaming instead of failing"); capacity unknown
+        (CPU without LGBM_TPU_HBM_BYTES) keeps resident. on: force
+        streaming, raising on ineligible configurations. The slab size
+        comes from ``tpu_stream_slab_rows`` or the memory model's auto
+        sizing (obs/memory.stream_auto_slab_rows)."""
+        cfg = self.config
+        mode = str(cfg.tpu_stream).lower()
+        if mode in ("off", "0", "false", "none", ""):
+            return None
+        if mode in ("on", "true", "1"):
+            forced = True
+        elif mode == "auto":
+            forced = False
+        else:
+            raise ValueError(f"tpu_stream={cfg.tpu_stream!r} is not one "
+                             "of auto/on/off")
+        why = self._stream_ineligible(train_set)
+        if why is not None:
+            if forced:
+                raise ValueError(f"tpu_stream=on: {why}")
+            return None
+        from .obs import memory as obs_memory
+        n = int(train_set.num_data)
+        f_storage = int(train_set.bins_fm.shape[0])
+        kw = obs_memory._resolve_train_knobs(
+            cfg, n, f_storage, self.num_tree_per_iteration)
+        kw["valid_rows"] = []
+        cap = obs_memory.device_capacity_bytes()
+        if not forced:
+            if str(cfg.tpu_preflight).lower() in ("off", "0", "false",
+                                                  "none"):
+                return None  # auto-streaming IS a preflight action
+            if cap is None:
+                return None
+            resident = obs_memory.train_memory_model(**kw)
+            if resident["peak_bytes"] <= cap:
+                return None
+            from . import log
+            log.warning(
+                f"memory preflight: resident training needs "
+                f"{resident['peak_bytes'] / 1e9:.2f} GB against "
+                f"{cap / 1e9:.2f} GB capacity; streaming host-resident "
+                "bins instead (tpu_stream=auto)")
+        slab_rows = int(cfg.tpu_stream_slab_rows or 0)
+        if slab_rows <= 0:
+            # size the slab against the STREAMED working set (gradients
+            # materialized, fused components off) — stream_model applies
+            # the same overrides preflight's recommendation uses, so the
+            # slab the booster builds is the slab preflight projected
+            slab_rows = obs_memory.stream_model(kw, cap)["slab_rows"]
+        # slab packing mirrors _maybe_pack_bins' gates exactly: the mesh
+        # paths (shard_map pallas histogram wrappers) assume raw
+        # row-aligned [F, N] storage, so sharded streaming keeps raw
+        # slabs just like sharded resident training does
+        pack = (str(cfg.tpu_bin_pack) not in ("off", "0", "false",
+                                              "False")
+                and cfg.tree_learner == "serial"
+                and int(cfg.tpu_num_shards or 0) <= 1)
+        from .io.streaming import HostSlabBins
+        return HostSlabBins(np.asarray(train_set.bins_fm),
+                            int(train_set.max_bins), slab_rows,
+                            pack=pack)
+
     def _parse_forced_splits(self):
         """forcedsplits_filename JSON -> (leaf, feature, threshold_bin)
         int32 arrays aligned with scan steps, or None
@@ -369,8 +465,19 @@ class GBDT:
                                self.config.feature_fraction_bynode < 1.0)
         self._extra_key = jax.random.PRNGKey(self.config.extra_seed)
         self._fused_grad_fn = self._resolve_fused_grad()
-        self._grow = obs_xla.instrumented_jit(
-            "boosting/grow", self._grow_partial(), phase="grow")
+        if self._stream is not None:
+            # out-of-core streaming: the grower is host-orchestrated
+            # over HostSlabBins; the slow path's `self._grow` becomes
+            # the streamed adapter (same call signature, bins argument
+            # carries the plan), and the fast paths route through
+            # _train_one_iter_stream
+            self._stream.mesh = shard_mesh or getattr(self, "mesh", None)
+            self._stream_grower = self._make_stream_grower(hist_impl)
+            self._grow = self._stream_grow_slow
+        else:
+            self._grow = obs_xla.instrumented_jit(
+                "boosting/grow", self._grow_partial(), phase="grow")
+        self._stream_progs = {}
         self._fused = None
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
@@ -386,6 +493,10 @@ class GBDT:
         so both keep the materialized-gradient path."""
         cfg = self.config
         if str(cfg.tpu_fused_grad) in ("off", "0", "false", "False"):
+            return None
+        if self._stream is not None:
+            # the streamed prep program materializes gradients (the
+            # slab passes consume a resident ghT operand)
             return None
         if not self._use_waved() or self.num_tree_per_iteration != 1:
             return None
@@ -467,6 +578,8 @@ class GBDT:
             num_shards=int(mesh.size) if mesh is not None else 1,
             has_weight=self.train_set.metadata.weight is not None,
             valid_rows=[vs.num_data for vs, _ in self._valid_sets],
+            stream_slab_rows=(self._stream.slab_rows
+                              if self._stream is not None else 0),
         )
 
     def _note_memory_model(self) -> None:
@@ -481,7 +594,8 @@ class GBDT:
             return  # COO working sets are nnz-shaped, not modeled yet
         from .obs import memory as obs_memory
         kw = self._memory_model_kwargs()
-        report = obs_memory.train_report(kw)
+        report = obs_memory.train_report(
+            kw, stream_ok=self._stream_ineligible(self.train_set) is None)
         global_metrics.set_meta("mem_model", report.model)
         global_metrics.set_meta("mem_peak_model_bytes", report.peak_bytes)
         mode = str(self.config.tpu_preflight).lower()
@@ -906,7 +1020,308 @@ class GBDT:
                                         phase="train",
                                         donate_argnums=(3, 4, 5))
 
+    # ------------------------------------------------------------------
+    # streamed path (tpu_stream): the fused program's math, split at
+    # materialization boundaries so the grower can be host-orchestrated
+    # over HostSlabBins slabs. Same RNG folds, same traced expressions
+    # (each kept whole within one program so XLA's FMA-contraction
+    # choices can't diverge) => models bit-identical to the resident
+    # fused path whenever the slab accumulation itself is exact
+    # (single slab, or int8-quantized histograms at any slab count).
+    def _make_stream_grower(self, hist_impl: str):
+        from .learner import StreamTreeGrower
+        mesh = self._stream.mesh
+        if mesh is not None and mesh.size > 1 and hist_impl == "pallas":
+            # pallas_call does not auto-partition under GSPMD and the
+            # shard_map wrappers assume resident bins; sharded streaming
+            # rides the XLA contraction (GSPMD inserts the psum)
+            hist_impl = "xla"
+        return StreamTreeGrower(
+            self._stream,
+            num_leaves=self._static["num_leaves"],
+            max_bins=self._static["max_bins"],
+            num_features=self.train_set.num_features,
+            hist_impl=hist_impl,
+            hist_precision=self.config.tpu_hist_precision,
+            has_categorical=any(m.is_categorical
+                                for m in self.train_set.mappers),
+            extra_trees=bool(self.config.extra_trees),
+            ff_bynode=float(self.config.feature_fraction_bynode),
+            wave_max=self._resolved_wave_max(),
+            subtract_siblings=bool(self.config.tpu_wave_subtract),
+            hist_deterministic=bool(self.config.deterministic_hist))
+
+    def _stream_prog(self, name: str, builder):
+        prog = self._stream_progs.get(name)
+        if prog is None:
+            prog = self._stream_progs[name] = obs_xla.instrumented_jit(
+                f"boosting/stream_{name}", builder(), phase="train")
+        return prog
+
+    def _make_stream_prep(self):
+        """Head of the streamed iteration: bagging + gradients — the
+        same RNG folds and expressions as the fused program's head."""
+        def prep(obj_state, scores, sample_mask, it):
+            obj = self.objective
+            old = obj.swap_device_state(obj_state) if obj is not None \
+                else None
+            try:
+                key = jax.random.fold_in(self._bagging_key, it)
+                sample_mask = self._sampling_in_jit(
+                    jax.random.fold_in(key, 1), it, sample_mask)
+                grad_all, hess_all = self._grad_fn(scores)
+                out_state = (obj.device_state(evolving_only=True)
+                             if obj is not None
+                             else {"arrays": {}, "sub": {}})
+                return sample_mask, grad_all, hess_all, out_state
+            finally:
+                if obj is not None:
+                    obj.swap_device_state(old)
+        return prep
+
+    def _make_stream_class_prep(self, k: int):
+        """Per-class sampling/quantization + the grower's resident
+        operands: the pre-masked ghT histogram operand (int8 when the
+        int8 wave path applies, f32 otherwise), its dequantization
+        vector, the root sums, and the feature mask. Identical RNG
+        salts to _grow_class_traced."""
+        use_int8 = (self._quant_enabled and
+                    int(self.config.num_grad_quant_bins) <= 126)
+
+        def class_prep(grad, hess, sample_mask, it):
+            key = jax.random.fold_in(self._bagging_key, it)
+            mask = sample_mask
+            if self.config.data_sample_strategy == "goss":
+                mask, scale = self._goss_in_jit(
+                    jax.random.fold_in(key, 100 + k), grad, hess)
+                grad, hess = grad * scale, hess * scale
+            true_grad, true_hess = grad, hess
+            quant = None
+            if self._quant_enabled:
+                grad, hess, quant = self._discretize_in_jit(
+                    jax.random.fold_in(key, 300 + k), grad, hess)
+            fmask = self._feature_mask_in_jit(
+                jax.random.fold_in(key, 200 + k))
+            f32 = jnp.float32
+            root_g = jnp.sum(grad * mask, dtype=f32)
+            root_h = jnp.sum(hess * mask, dtype=f32)
+            root_c = jnp.sum(mask, dtype=f32)
+            if use_int8:
+                g_int, h_int, g_scale, h_scale = quant
+                m8 = mask.astype(jnp.int8)
+                ghT = jnp.stack([g_int.astype(jnp.int8) * m8,
+                                 h_int.astype(jnp.int8) * m8, m8], axis=1)
+                hscale = jnp.stack([g_scale, h_scale,
+                                    jnp.float32(1.0)]).astype(f32)
+            else:
+                ghT = jnp.stack([grad * mask, hess * mask, mask],
+                                axis=1).astype(f32)
+                hscale = jnp.ones((3,), f32)
+            return (ghT, hscale, root_g, root_h, root_c, fmask,
+                    true_grad, true_hess, mask)
+        return class_prep
+
+    def _make_stream_class_post(self, k: int):
+        """Leaf renewal + score/valid updates for one grown class —
+        the tail of the fused loop body, kept in ONE program so the
+        multiply-gather-add keeps the fused path's FMA shape."""
+        def class_post(obj_state, rec, row_leaf, scores, valid_scores,
+                       valid_bins, mask, true_grad, true_hess, lr):
+            obj = self.objective
+            old = obj.swap_device_state(obj_state) if obj is not None \
+                else None
+            try:
+                if self._quant_enabled and \
+                        self.config.quant_train_renew_leaf:
+                    rec = self._renew_leaves_in_jit(
+                        rec, row_leaf, true_grad, true_hess, mask)
+                if obj is not None:
+                    renewed_lv = obj.renew_leaves_traced(
+                        rec.leaf_value, row_leaf, scores[k], mask)
+                    if renewed_lv is not None:
+                        rec = rec._replace(leaf_value=jnp.where(
+                            rec.num_leaves > 1, renewed_lv,
+                            rec.leaf_value))
+                leaf_vals = jnp.where(rec.num_leaves > 1,
+                                      rec.leaf_value * lr, 0.0)
+                scores = scores.at[k].add(leaf_vals[row_leaf])
+                new_valid = list(valid_scores)
+                for vi in range(len(valid_bins)):
+                    vleaf = replay_tree(
+                        rec, valid_bins[vi], self.feature_meta,
+                        self._bundle,
+                        num_data=self._valid_sets[vi][0].num_data)
+                    new_valid[vi] = new_valid[vi].at[k].add(
+                        leaf_vals[vleaf])
+                return rec, scores, tuple(new_valid)
+            finally:
+                if obj is not None:
+                    obj.swap_device_state(old)
+        return class_post
+
+    def _stream_grow_class(self, k: int, grad_k, hess_k, sample_mask, it):
+        """Shared per-class streamed growth (fast twin + DART twin):
+        class prep program -> host-orchestrated slab grower."""
+        cp = self._stream_prog(f"class_prep_{k}",
+                               lambda: self._make_stream_class_prep(k))
+        (ghT, hscale, root_g, root_h, root_c, fmask,
+         true_grad, true_hess, mask) = cp(grad_k, hess_k, sample_mask, it)
+        node_key = (jax.random.fold_in(
+            self._extra_key,
+            self.iter * self.num_tree_per_iteration + k)
+            if self._use_node_rand else None)
+        rec, row_leaf = self._stream_grower.grow(
+            ghT, hscale, (root_g, root_h, root_c), fmask,
+            self.feature_meta, self.hp, self.max_depth, node_key)
+        return rec, row_leaf, mask, true_grad, true_hess
+
+    def _stream_grow_slow(self, bins_fm, grad, hess, mask, feature_mask,
+                          meta, hp, max_depth, forced=None, node_key=None):
+        """Slow-path adapter with the resident grower's signature
+        (`bins_fm` carries the HostSlabBins plan): custom-gradient /
+        RF / host-renewing objectives stream through the same driver
+        code they use resident."""
+        assert forced is None, \
+            "forced splits are gated out of streaming at resolve time"
+
+        def basic_prep(grad_, hess_, mask_):
+            f32 = jnp.float32
+            ghT = jnp.stack([grad_ * mask_, hess_ * mask_, mask_],
+                            axis=1).astype(f32)
+            return (ghT, jnp.sum(grad_ * mask_, dtype=f32),
+                    jnp.sum(hess_ * mask_, dtype=f32),
+                    jnp.sum(mask_, dtype=f32))
+
+        prep = self._stream_prog("slow_prep", lambda: basic_prep)
+        ghT, root_g, root_h, root_c = prep(grad, hess, mask)
+        return self._stream_grower.grow(
+            ghT, jnp.ones((3,), jnp.float32), (root_g, root_h, root_c),
+            feature_mask, meta, hp, max_depth, node_key)
+
+    def _note_stream_meta(self) -> None:
+        """Publish the streaming pipeline accounting (always-on meta ->
+        bench JSON `stream` field + lgbmtpu_stream_* OpenMetrics)."""
+        from .io.streaming import global_stream_stats
+        plan = self._stream
+        global_metrics.set_meta("stream", {
+            **global_stream_stats.summary(),
+            "slab_rows": int(plan.slab_rows),
+            "n_slabs": int(plan.n_slabs),
+            "num_data": int(plan.num_data),
+            "host_bytes": int(plan.nbytes_host),
+        })
+
+    def _stream_take_bins(self):
+        """Single-slab streaming: the staged device copy of the whole
+        (packed) bin matrix. Uploaded once and cached — the bins are
+        immutable, so re-staging identical bytes every iteration would
+        only waste link bandwidth, and holding the one copy is exactly
+        the memory the model budgeted for the slab pair. The plan
+        degenerates to resident behavior with an explicit upload, which
+        is what makes single-slab streamed models bit-identical."""
+        dev = self._stream_next_bins
+        if dev is None:
+            dev = self._stream_next_bins = self._stream.stage_noted(0)
+        return dev
+
+    def _stream_prefetch_bins(self) -> None:
+        """Called right after the fused program dispatches (async):
+        bookkeeping hook of the cross-iteration pipeline (the cached
+        single-slab upload needs no re-stage; multi-slab plans overlap
+        via HostSlabBins.feed instead)."""
+        self._stream.stats.note_dispatch()
+
+    def _train_one_iter_stream(self) -> bool:
+        """Streamed iteration dispatch. A single-slab plan (the whole
+        matrix fits the streaming budget — every fits-in-HBM fixture)
+        runs the SAME fused XLA program as resident training on a
+        staged-once upload of the bins: bit-identical models by
+        construction. Multi-slab plans run the host-orchestrated slab
+        grower (bit-identical to the resident host/slow path; int8
+        histograms stay bit-identical at any slab count)."""
+        if self._stream.n_slabs == 1:
+            return self._train_one_iter_fused_upload()
+        return self._train_one_iter_stream_orchestrated()
+
+    def _train_one_iter_fused_upload(self) -> bool:
+        import time as _time
+        from .io.streaming import global_stream_stats as _stats
+        self._boost_from_average()
+        if self._fused is None:
+            with global_tracer.span("train/compile_fused"):
+                self._fused = self._make_fused()
+        bins = self._stream_take_bins()
+        with global_tracer.span("train/iteration",
+                                block=lambda: self.scores):
+            out = self._fused(
+                bins, tuple(self._valid_bins), self._obj_state(),
+                self.scores, self._sample_mask, tuple(self._valid_scores),
+                jnp.int32(self.iter), jnp.float32(self.shrinkage_rate))
+            self._stream_prefetch_bins()
+            if self._health_armed:
+                (self.scores, self._sample_mask, valid, recs,
+                 new_obj_state, self._health_vec) = out
+            else:
+                (self.scores, self._sample_mask, valid, recs,
+                 new_obj_state) = out
+            t0 = _time.perf_counter()
+            jax.block_until_ready(self.scores)
+            _stats.note_block(_time.perf_counter() - t0)
+        if self.objective is not None:
+            self.objective.swap_device_state(new_obj_state)
+        self._valid_scores = list(valid)
+        self._device_records.append(recs)
+        self._record_lrs.append(self.shrinkage_rate)
+        _stats.iterations_total += 1
+        self._note_stream_meta()
+        self.iter += 1
+        return False
+
+    def _train_one_iter_stream_orchestrated(self) -> bool:
+        import time as _time
+        self._boost_from_average()
+        from .io.streaming import global_stream_stats as _stats
+        prep = self._stream_prog("prep", self._make_stream_prep)
+        with global_tracer.span("train/iteration",
+                                block=lambda: self.scores):
+            it = jnp.int32(self.iter)
+            lr = jnp.float32(self.shrinkage_rate)
+            sample_mask, grad_all, hess_all, new_obj_state = prep(
+                self._obj_state(), self.scores, self._sample_mask, it)
+            self._sample_mask = sample_mask
+            if self.objective is not None:
+                self.objective.swap_device_state(new_obj_state)
+            recs = []
+            for k in range(self.num_tree_per_iteration):
+                rec, row_leaf, mask, true_g, true_h = \
+                    self._stream_grow_class(k, grad_all[k], hess_all[k],
+                                            sample_mask, it)
+                post = self._stream_prog(
+                    f"class_post_{k}",
+                    lambda k=k: self._make_stream_class_post(k))
+                rec, self.scores, valid = post(
+                    self._obj_state(), rec, row_leaf, self.scores,
+                    tuple(self._valid_scores), tuple(self._valid_bins),
+                    mask, true_g, true_h, lr)
+                self._valid_scores = list(valid)
+                recs.append(rec)
+            if self._health_armed:
+                sen = self._stream_prog(
+                    "sentinel", lambda: _nonfinite_counts)
+                self._health_vec = sen(grad_all, hess_all, self.scores)
+            t0 = _time.perf_counter()
+            jax.block_until_ready(self.scores)
+            _stats.note_block(_time.perf_counter() - t0)
+        _stats.iterations_total += 1
+        self._device_records.append(_stack_class_records(recs))
+        self._record_lrs.append(self.shrinkage_rate)
+        self._note_stream_meta()
+        self.iter += 1
+        return False
+
     def _train_one_iter_fast(self) -> bool:
+        if self._stream is not None:
+            return self._train_one_iter_stream()
         self._boost_from_average()
         if self._fused is None:
             with global_tracer.span("train/compile_fused"):
@@ -1331,6 +1746,19 @@ class GBDT:
                     self._cegb_used, 0.0, self._cegb_coupled)
                 self.feature_meta = self.feature_meta._replace(
                     cegb_feat=jnp.asarray(new_pen.astype(np.float32)))
+        if self._stream is not None:
+            # slow-path streamed iterations (custom fobj / RF / CEGB /
+            # host-renewing objectives) carry the same always-on stream
+            # accounting as the fast twins — and the end-of-iteration
+            # sync resets the overlap classifier's in-flight count so a
+            # later pipeline can't inherit stale dispatches
+            import time as _time
+            from .io.streaming import global_stream_stats as _stats
+            t0 = _time.perf_counter()
+            jax.block_until_ready(self.scores)
+            _stats.note_block(_time.perf_counter() - t0)
+            _stats.iterations_total += 1
+            self._note_stream_meta()
         self.iter += 1
         return False
 
@@ -1370,6 +1798,7 @@ class GBDT:
         self._valid_bins.append(vbins if vbins is not None
                                 else valid_set.device_bins())
         self._fused = None  # fused program must include the new valid set
+        self._stream_progs = {}  # streamed post programs carry valid sets
         # the valid bins + scores just moved on device: refresh the
         # published peak-memory model (and re-judge the preflight) so a
         # big eval set can't silently blow past a "fits" verdict
@@ -1954,8 +2383,244 @@ class DART(GBDT):
                                         phase="train",
                                         donate_argnums=(3, 4, 5, 6, 7, 8, 9))
 
+    # -- streamed DART twin (tpu_stream): _make_fused_dart's math split
+    # at the same materialization boundaries as the GBDT streamed path
+    def _make_stream_dart_prep(self):
+        xgb_mode = bool(self.config.xgboost_dart_mode)
+        n_valid = len(self._valid_sets)
+
+        def prep(obj_state, scores, sample_mask, leaf_hist, vhists,
+                 leaf_vals, factors, dropped, n_drop, it, lr):
+            obj = self.objective
+            old = obj.swap_device_state(obj_state)
+            try:
+                key = jax.random.fold_in(self._bagging_key, it)
+                sample_mask = self._sampling_in_jit(
+                    jax.random.fold_in(key, 1), it, sample_mask)
+                live = dropped >= 0
+                d_gather = jnp.where(live, dropped, 0)
+                fac_d = factors[d_gather] * live.astype(jnp.float32)
+
+                def drop_delta(hist, vals):
+                    h = jnp.take(hist, d_gather, axis=0).astype(jnp.int32)
+                    v = jnp.take(vals, d_gather, axis=0) * \
+                        fac_d[:, None, None]
+                    return jnp.take_along_axis(v, h, axis=2).sum(axis=0)
+
+                delta = drop_delta(leaf_hist, leaf_vals)
+                deltas_v = tuple(drop_delta(vhists[vi], leaf_vals)
+                                 for vi in range(n_valid))
+                scores_adj = scores - delta
+                grad_all, hess_all = self._grad_fn(scores_adj)
+                kd = n_drop.astype(jnp.float32)
+                if xgb_mode:
+                    new_factor = jnp.where(n_drop > 0, lr / (lr + kd), lr)
+                    old_factor = kd / (kd + lr)
+                else:
+                    new_factor = lr / (1.0 + kd)
+                    old_factor = kd / (kd + 1.0)
+                out_state = obj.device_state(evolving_only=True)
+                return (sample_mask, scores_adj, delta, deltas_v,
+                        grad_all, hess_all, new_factor, old_factor,
+                        out_state)
+            finally:
+                obj.swap_device_state(old)
+        return prep
+
+    def _make_stream_dart_post(self, k: int):
+        hd = self._dart_hist_dtype()
+        with_bias = self._dart_base == 0 and any(
+            abs(s) > K_EPSILON for s in self.init_scores)
+        init_vec = jnp.asarray(np.asarray(self.init_scores, np.float32))
+
+        def post(obj_state, rec, row_leaf, scores, scores_adj, delta,
+                 valid_scores, valid_bins, vhists, leaf_hist, leaf_vals,
+                 new_factor, old_factor, deltas_v, t_cur, mask,
+                 true_grad, true_hess):
+            obj = self.objective
+            old = obj.swap_device_state(obj_state) if obj is not None \
+                else None
+            try:
+                if self._quant_enabled and \
+                        self.config.quant_train_renew_leaf:
+                    rec = self._renew_leaves_in_jit(
+                        rec, row_leaf, true_grad, true_hess, mask)
+                if obj is not None:
+                    renewed_lv = obj.renew_leaves_traced(
+                        rec.leaf_value, row_leaf, scores_adj[k], mask)
+                    if renewed_lv is not None:
+                        rec = rec._replace(leaf_value=jnp.where(
+                            rec.num_leaves > 1, renewed_lv,
+                            rec.leaf_value))
+                lv = jnp.where(rec.num_leaves > 1, rec.leaf_value, 0.0)
+                scores = scores.at[k].set(
+                    scores_adj[k] + old_factor * delta[k]
+                    + new_factor * lv[row_leaf])
+                leaf_hist = leaf_hist.at[t_cur, k].set(
+                    row_leaf.astype(hd))
+                lv_store = lv
+                if with_bias:
+                    # see _make_fused_dart: first-iteration trees carry
+                    # bias/creation_factor in the history buffer
+                    lv_store = lv + jnp.where(
+                        t_cur == 0, init_vec[k] / new_factor, 0.0)
+                leaf_vals = leaf_vals.at[t_cur, k].set(lv_store)
+                new_valid = list(valid_scores)
+                new_vhists = list(vhists)
+                for vi in range(len(valid_bins)):
+                    vleaf = replay_tree(
+                        rec, valid_bins[vi], self.feature_meta,
+                        self._bundle,
+                        num_data=self._valid_sets[vi][0].num_data)
+                    new_valid[vi] = new_valid[vi].at[k].set(
+                        new_valid[vi][k]
+                        - (1.0 - old_factor) * deltas_v[vi][k]
+                        + new_factor * lv[vleaf])
+                    new_vhists[vi] = new_vhists[vi].at[t_cur, k].set(
+                        vleaf.astype(hd))
+                return (rec, scores, tuple(new_valid),
+                        tuple(new_vhists), leaf_hist, leaf_vals)
+            finally:
+                if obj is not None:
+                    obj.swap_device_state(old)
+        return post
+
+    def _make_stream_dart_factors(self):
+        def upd(factors, dropped, t_cur, new_factor, old_factor):
+            t_max = factors.shape[0]
+            live = dropped >= 0
+            d_scatter = jnp.where(live, dropped, t_max)  # OOB = no-op
+            factors = factors.at[d_scatter].multiply(old_factor)
+            return factors.at[t_cur].set(new_factor)
+        return upd
+
+    def _train_one_iter_fused_upload(self) -> bool:
+        """Single-slab streamed DART: the fused DART program on a
+        per-iteration upload of the bins (see the GBDT twin)."""
+        import time as _time
+        from .io.streaming import global_stream_stats as _stats
+        self._boost_from_average()
+        self._ensure_dart_state()
+        drop_slots = self._select_drop(self._dart_t)
+        n_drop = len(drop_slots)
+        global_metrics.observe("dart_dropped_trees", n_drop)
+        d_cap = max(int(self.config.max_drop), 1)
+        dropped = np.full(d_cap, -1, np.int32)
+        dropped[:n_drop] = drop_slots
+        if self._dart_fused is None:
+            with global_tracer.span("train/compile_fused"):
+                self._dart_fused = self._make_fused_dart()
+        st = self._dart
+        bins = self._stream_take_bins()
+        with global_tracer.span("train/iteration",
+                                block=lambda: self.scores):
+            out = self._dart_fused(
+                bins, tuple(self._valid_bins), self._obj_state(),
+                self.scores, self._sample_mask, tuple(self._valid_scores),
+                st["leaf_hist"], tuple(st["vhist"]), st["leaf_vals"],
+                st["factors"], jnp.asarray(dropped), jnp.int32(n_drop),
+                jnp.int32(self._dart_t), jnp.int32(self.iter),
+                jnp.float32(self.config.learning_rate))
+            self._stream_prefetch_bins()
+            if self._health_armed:
+                out, self._health_vec = out[:-1], out[-1]
+            (self.scores, self._sample_mask, valid, recs, new_obj_state,
+             st["leaf_hist"], vhist, st["leaf_vals"],
+             st["factors"]) = out
+            t0 = _time.perf_counter()
+            jax.block_until_ready(self.scores)
+            _stats.note_block(_time.perf_counter() - t0)
+        st["vhist"] = list(vhist)
+        if self.objective is not None:
+            self.objective.swap_device_state(new_obj_state)
+        self._valid_scores = list(valid)
+        self._device_records.append(recs)
+        self._dart_t += 1
+        self.iter += 1
+        _stats.iterations_total += 1
+        self._note_stream_meta()
+        new_factor, _old = self._dart_factors(n_drop)
+        self._update_drop_weights(drop_slots)
+        self._tree_weights.append(new_factor)
+        self._sum_tree_weight += new_factor
+        return False
+
+    def _train_one_iter_stream_orchestrated(self) -> bool:
+        import time as _time
+        self._boost_from_average()
+        self._ensure_dart_state()
+        from .io.streaming import global_stream_stats as _stats
+        drop_slots = self._select_drop(self._dart_t)
+        n_drop = len(drop_slots)
+        global_metrics.observe("dart_dropped_trees", n_drop)
+        d_cap = max(int(self.config.max_drop), 1)
+        dropped = np.full(d_cap, -1, np.int32)
+        dropped[:n_drop] = drop_slots
+        dropped = jnp.asarray(dropped)
+        st = self._dart
+        prep = self._stream_prog("dart_prep", self._make_stream_dart_prep)
+        with global_tracer.span("train/iteration",
+                                block=lambda: self.scores):
+            it = jnp.int32(self.iter)
+            t_cur = jnp.int32(self._dart_t)
+            (sample_mask, scores_adj, delta, deltas_v, grad_all,
+             hess_all, new_f, old_f, new_obj_state) = prep(
+                self._obj_state(), self.scores, self._sample_mask,
+                st["leaf_hist"], tuple(st["vhist"]), st["leaf_vals"],
+                st["factors"], dropped, jnp.int32(n_drop), it,
+                jnp.float32(self.config.learning_rate))
+            self._sample_mask = sample_mask
+            if self.objective is not None:
+                self.objective.swap_device_state(new_obj_state)
+            recs = []
+            scores = self.scores
+            valid = tuple(self._valid_scores)
+            vhists = tuple(st["vhist"])
+            leaf_hist, leaf_vals = st["leaf_hist"], st["leaf_vals"]
+            for k in range(self.num_tree_per_iteration):
+                rec, row_leaf, mask, true_g, true_h = \
+                    self._stream_grow_class(k, grad_all[k], hess_all[k],
+                                            sample_mask, it)
+                post = self._stream_prog(
+                    f"dart_post_{k}",
+                    lambda k=k: self._make_stream_dart_post(k))
+                (rec, scores, valid, vhists, leaf_hist, leaf_vals) = \
+                    post(self._obj_state(), rec, row_leaf, scores,
+                         scores_adj, delta, valid,
+                         tuple(self._valid_bins), vhists, leaf_hist,
+                         leaf_vals, new_f, old_f, deltas_v, t_cur,
+                         mask, true_g, true_h)
+                recs.append(rec)
+            fac = self._stream_prog("dart_factors",
+                                    self._make_stream_dart_factors)
+            st["factors"] = fac(st["factors"], dropped, t_cur, new_f,
+                                old_f)
+            self.scores = scores
+            self._valid_scores = list(valid)
+            st["vhist"] = list(vhists)
+            st["leaf_hist"], st["leaf_vals"] = leaf_hist, leaf_vals
+            if self._health_armed:
+                sen = self._stream_prog(
+                    "sentinel", lambda: _nonfinite_counts)
+                self._health_vec = sen(grad_all, hess_all, self.scores)
+            t0 = _time.perf_counter()
+            jax.block_until_ready(self.scores)
+            _stats.note_block(_time.perf_counter() - t0)
+        _stats.iterations_total += 1
+        self._device_records.append(_stack_class_records(recs))
+        self._dart_t += 1
+        self.iter += 1
+        self._note_stream_meta()
+        new_factor, _old = self._dart_factors(n_drop)
+        self._update_drop_weights(drop_slots)
+        self._tree_weights.append(new_factor)
+        self._sum_tree_weight += new_factor
+        return False
+
     def _train_one_iter_fast(self) -> bool:
         """Fused DART iteration (the DART twin of the GBDT fast path)."""
+        if self._stream is not None:
+            return self._train_one_iter_stream()
         self._boost_from_average()
         self._ensure_dart_state()
         drop_slots = self._select_drop(self._dart_t)

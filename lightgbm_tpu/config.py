@@ -175,6 +175,8 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_hist_impl": [],
     "tpu_sparse_hist": [],
     "tpu_bin_pack": ["bin_pack"],
+    "tpu_stream": ["stream", "out_of_core"],
+    "tpu_stream_slab_rows": ["stream_slab_rows", "slab_rows"],
     "tpu_fused_grad": ["fused_grad"],
     "tpu_wave_subtract": [],
     "deterministic_hist": ["tpu_deterministic_hist"],
@@ -516,6 +518,30 @@ class Config:
     # integer-valued gradients, tests/test_bin_pack.py). Dense unbundled
     # serial storage only; EFB/COO/mesh layouts stay unpacked.
     tpu_bin_pack: str = "auto"
+    # out-of-core streaming training (ROADMAP item 1; io/streaming.py +
+    # learner.StreamTreeGrower): keep the [F, N] bin tensor HOST-
+    # resident, cut into section-aligned row slabs that stream to the
+    # device wave-by-wave, double-buffered so slab k+1 uploads while
+    # the fused histogram/partition programs consume slab k. "auto"
+    # streams only when lgb.preflight()'s analytic memory model says
+    # resident training does NOT fit device capacity (never on CPU
+    # where capacity is unknown, unless LGBM_TPU_HBM_BYTES is set);
+    # "on" forces streaming (raises when the shape is ineligible:
+    # EFB/COO storage, forced splits, exact-order growth, interaction
+    # or pairwise-monotone constraints, linear trees); "off" never
+    # streams. Single-slab streamed models are bit-identical to
+    # resident ones; quantized (int8-histogram) streaming is
+    # bit-identical at ANY slab count (integer partial sums); plain
+    # f32 multi-slab accumulation carries ~1-ulp-per-slab float-add
+    # association drift.
+    tpu_stream: str = "auto"
+    # streaming slab size in rows; 0 = auto (the largest
+    # section-aligned slab whose double-buffered working set fits the
+    # capacity left after the resident row state — obs/memory.
+    # stream_auto_slab_rows). Rounded up to the slab alignment
+    # (pack-factor x 2048 rows) so every full slab shares one compiled
+    # program shape.
+    tpu_stream_slab_rows: int = 0
     # fuse the gradient/bagging element-wise pass into the histogram
     # waves: the objective's pointwise gradient (objectives.
     # pointwise_grad_fn — binary, L2 regression) is evaluated inside the

@@ -731,6 +731,234 @@ def hist_traffic_model(*, num_data: int, storage_features: int,
     }
 
 
+def _wave_step_stored(carry, step_idx, *, L, meta, hp, unknown,
+                      mono_pairwise, partition_fn=None):
+    """One stored-candidate split application (no histogram builds) —
+    the scan body shared by the resident waved grower and the streamed
+    grower's wave-apply program (the streamed twin must run the SAME
+    traced ops so models stay bit-identical across the modes).
+
+    ``partition_fn(row_leaf, best, new, feat, thr, dleft, cmask, valid)``
+    applies the split to row_leaf immediately (the per-split partition
+    path); None leaves row_leaf untouched (batched wave partition, or
+    the streamed grower where partition runs per slab).
+
+    Invalid steps use the out-of-bounds id L: every .at[] write to it
+    is dropped (jit scatter semantics), so a dummy can never clobber a
+    real leaf's slot."""
+    row_leaf, leaves, used, n_applied, box_lo, box_hi = carry
+    best_leaf = jnp.argmax(leaves.gain).astype(jnp.int32)
+    valid = leaves.gain[best_leaf] > 0.0
+    new_leaf = jnp.where(valid, n_applied + 1, L).astype(jnp.int32)
+    n_applied = n_applied + valid.astype(jnp.int32)
+    feat = leaves.feature[best_leaf]
+    thr = leaves.threshold[best_leaf]
+    dleft = leaves.default_left[best_leaf]
+    cmask = leaves.cat_mask[best_leaf]
+
+    if partition_fn is not None:
+        row_leaf = partition_fn(row_leaf, best_leaf, new_leaf, feat, thr,
+                                dleft, cmask, valid)
+
+    pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
+                  leaves.count[best_leaf])
+    lg = leaves.left_sum_grad[best_leaf]
+    lh = leaves.left_sum_hess[best_leaf]
+    lc = leaves.left_count[best_leaf]
+    rg, rh, rc = pg - lg, ph - lh, pc - lc
+    parent_out = leaves.output[best_leaf]
+    p_minb = leaves.min_bound[best_leaf]
+    p_maxb = leaves.max_bound[best_leaf]
+    out_l = leaves.left_output[best_leaf]
+    out_r = leaves.right_output[best_leaf]
+    chosen_gain = leaves.gain[best_leaf]
+
+    if mono_pairwise:
+        # bounds may have tightened since this candidate was stored
+        out_l = jnp.clip(out_l, p_minb, p_maxb)
+        out_r = jnp.clip(out_r, p_minb, p_maxb)
+        box_lo, box_hi = split_ops.split_child_boxes(
+            box_lo, box_hi, best_leaf, new_leaf, feat, thr,
+            meta.is_categorical[feat], valid)
+        out_now = leaves.output.at[best_leaf].set(
+            jnp.where(valid, out_l, parent_out))
+        ni = jnp.minimum(new_leaf, L - 1)
+        out_now = out_now.at[new_leaf].set(
+            jnp.where(valid, out_r, out_now[ni]))
+        leaf_in_use = jnp.arange(L, dtype=jnp.int32) <= n_applied
+        minb_all, maxb_all = split_ops.compute_box_bounds(
+            box_lo, box_hi, out_now, leaf_in_use, meta.monotone)
+        leaves = leaves._replace(
+            min_bound=jnp.where(valid, minb_all, leaves.min_bound),
+            max_bound=jnp.where(valid, maxb_all, leaves.max_bound))
+        l_min, l_max = minb_all[best_leaf], maxb_all[best_leaf]
+        r_min, r_max = minb_all[ni], maxb_all[ni]
+    else:
+        l_min, l_max, r_min, r_max = split_ops.propagate_monotone_bounds(
+            out_l, out_r, meta.monotone[feat].astype(jnp.int32),
+            meta.is_categorical[feat], p_minb, p_maxb)
+
+    if used is not None:
+        child_used = used[best_leaf].at[feat].set(True)
+        used = used.at[best_leaf].set(
+            jnp.where(valid, child_used, used[best_leaf]))
+        used = used.at[new_leaf].set(
+            jnp.where(valid, child_used, used[new_leaf]))
+
+    child_depth = leaves.depth[best_leaf] + 1
+    # children have no candidates until the wave-boundary build
+    leaves = _store_split(leaves, best_leaf, unknown, child_depth,
+                          out_l, lg, lh, lc, l_min, l_max, valid)
+    leaves = _store_split(leaves, new_leaf, unknown, child_depth,
+                          out_r, rg, rh, rc, r_min, r_max, valid)
+
+    left_smaller = lc <= rc
+    record = dict(
+        split_leaf=jnp.where(valid, best_leaf, -1),
+        split_feature=feat,
+        split_bin_threshold=thr,
+        split_default_left=dleft,
+        split_gain=jnp.where(valid, chosen_gain, 0.0),
+        split_cat_mask=cmask,
+        internal_value=parent_out,
+        internal_weight=ph,
+        internal_count=pc,
+    )
+    ys = dict(record=record, valid=valid,
+              left_id=best_leaf, right_id=new_leaf,
+              small_id=jnp.where(left_smaller, best_leaf, new_leaf),
+              left_smaller=left_smaller)
+    return (row_leaf, leaves, used, n_applied, box_lo, box_hi), ys
+
+
+def _unknown_split(max_bins: int) -> SplitInfo:
+    """The no-candidate sentinel stored for freshly-created children
+    until the wave boundary builds their histograms."""
+    return SplitInfo(
+        gain=jnp.float32(K_MIN_SCORE), feature=jnp.int32(0),
+        threshold=jnp.int32(0), default_left=jnp.bool_(False),
+        left_sum_grad=jnp.float32(0), left_sum_hess=jnp.float32(0),
+        left_count=jnp.float32(0), right_sum_grad=jnp.float32(0),
+        right_sum_hess=jnp.float32(0), right_count=jnp.float32(0),
+        left_output=jnp.float32(0), right_output=jnp.float32(0),
+        cat_mask=jnp.zeros((max_bins,), jnp.bool_))
+
+
+def _init_wave_state(root_hist, root_g, root_h, root_c, meta, hp,
+                     root_fmask, node_key, *, L, max_bins, num_features,
+                     f32, has_categorical, extra_trees, ff_bynode,
+                     interaction_groups):
+    """Root leaf state + histogram pool from a built root histogram —
+    shared by the resident waved grower and the streamed grower (the
+    streamed root histogram arrives accumulated over slabs)."""
+    neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
+    root_out = leaf_output(root_g, root_h, hp)
+    rb_root, fm_root = _node_randomness(node_key, 0, meta, root_fmask,
+                                        extra_trees, ff_bynode)
+    root_split = find_best_split(root_hist, root_g, root_h, root_c,
+                                 meta, hp, fm_root, root_out,
+                                 neg_inf, pos_inf, jnp.int32(0),
+                                 has_categorical, rb_root)
+
+    zero_l = jnp.zeros((L,), f32)
+    leaves = _LeafSplits(
+        sum_grad=zero_l, sum_hess=zero_l, count=zero_l,
+        depth=jnp.zeros((L,), jnp.int32),
+        output=zero_l,
+        gain=jnp.full((L,), K_MIN_SCORE, f32),
+        feature=jnp.zeros((L,), jnp.int32),
+        threshold=jnp.zeros((L,), jnp.int32),
+        default_left=jnp.zeros((L,), jnp.bool_),
+        left_sum_grad=zero_l, left_sum_hess=zero_l, left_count=zero_l,
+        left_output=zero_l, right_output=zero_l,
+        cat_mask=jnp.zeros((L, max_bins), jnp.bool_),
+        min_bound=jnp.full((L,), -jnp.inf, f32),
+        max_bound=jnp.full((L,), jnp.inf, f32),
+    )
+    leaves = _store_split(leaves, 0, root_split, jnp.int32(1), root_out,
+                          root_g, root_h, root_c, neg_inf, pos_inf, True)
+    pool = jnp.zeros((L, num_features, max_bins,
+                      hist_ops.NUM_HIST_CHANNELS), f32)
+    pool = pool.at[0].set(root_hist)
+    used = (jnp.zeros((L, num_features), bool)
+            if interaction_groups is not None else None)
+    return leaves, pool, used
+
+
+def _wave_boundary_core(pool, leaves, used_features, ys, wave_hists,
+                        feature_mask, max_depth, node_key, s0, *,
+                        subtract_siblings, L, num_features, f32, meta, hp,
+                        interaction_groups, has_categorical, extra_trees,
+                        ff_bynode):
+    """Wave-boundary histogram bookkeeping + child candidate search,
+    given the wave's built histograms (`wave_hists`: the W smaller
+    children under subtraction, or both-children [2W] in oracle mode).
+    Shared by the resident waved grower (which builds wave_hists with
+    one resident multi-leaf pass) and the streamed grower (which
+    accumulates them over host-fed slabs)."""
+    W = ys["valid"].shape[0]
+    if subtract_siblings:
+        parents = pool[ys["left_id"]]                      # [W, F, B, 3]
+        small_h = wave_hists.astype(f32)
+        large_h = hist_ops.subtract_histogram(parents, small_h)
+        ls = ys["left_smaller"][:, None, None, None]
+        left_h = jnp.where(ls, small_h, large_h)
+        right_h = jnp.where(ls, large_h, small_h)
+    else:
+        left_h = wave_hists[:W].astype(f32)
+        right_h = wave_hists[W:].astype(f32)
+    left_w = jnp.where(ys["valid"], ys["left_id"], L)
+    right_w = jnp.where(ys["valid"], ys["right_id"], L)
+    pool = pool.at[left_w].set(left_h)
+    pool = pool.at[right_w].set(right_h)
+
+    def child_candidates(hist, cid, fmask_c, salt, leaves):
+        """find_best_split for one child from its stored stats."""
+        rb, fm = _node_randomness(node_key, salt, meta, fmask_c,
+                                  extra_trees, ff_bynode)
+        return find_best_split(
+            hist, leaves.sum_grad[cid], leaves.sum_hess[cid],
+            leaves.count[cid], meta, hp, fm, leaves.output[cid],
+            leaves.min_bound[cid], leaves.max_bound[cid],
+            leaves.depth[cid] - 1, has_categorical, rb)
+
+    # --- candidates for the 2W children, batched
+    child_ids = jnp.concatenate([ys["left_id"], ys["right_id"]])
+    child_valid = jnp.concatenate([ys["valid"], ys["valid"]])
+    hists = pool[child_ids]
+    if used_features is not None:
+        fmask_c = feature_mask[None, :] & jax.vmap(
+            _allowed_features, in_axes=(0, None))(
+                used_features[child_ids], interaction_groups)
+    else:
+        fmask_c = jnp.broadcast_to(feature_mask, (2 * W, num_features))
+    salts = 2 * s0 + jnp.arange(2 * W, dtype=jnp.int32)
+    infos = jax.vmap(child_candidates, in_axes=(0, 0, 0, 0, None))(
+        hists, child_ids, fmask_c, salts, leaves)
+    depth_ok = (max_depth <= 0) | (leaves.depth[child_ids] < max_depth)
+    gains = jnp.where(child_valid & depth_ok, infos.gain, K_MIN_SCORE)
+
+    def upd(arr, val):
+        keep = arr[child_ids]
+        return arr.at[child_ids].set(
+            jnp.where(child_valid.reshape(
+                (-1,) + (1,) * (val.ndim - 1)), val, keep))
+    leaves = leaves._replace(
+        gain=leaves.gain.at[child_ids].set(
+            jnp.where(child_valid, gains, leaves.gain[child_ids])),
+        feature=upd(leaves.feature, infos.feature),
+        threshold=upd(leaves.threshold, infos.threshold),
+        default_left=upd(leaves.default_left, infos.default_left),
+        left_sum_grad=upd(leaves.left_sum_grad, infos.left_sum_grad),
+        left_sum_hess=upd(leaves.left_sum_hess, infos.left_sum_hess),
+        left_count=upd(leaves.left_count, infos.left_count),
+        left_output=upd(leaves.left_output, infos.left_output),
+        right_output=upd(leaves.right_output, infos.right_output),
+        cat_mask=upd(leaves.cat_mask, infos.cat_mask),
+    )
+    return pool, leaves
+
+
 def grow_tree_waved(bins_fm: jax.Array,
                     grad: jax.Array,
                     hess: jax.Array,
@@ -943,50 +1171,16 @@ def grow_tree_waved(bins_fm: jax.Array,
     root_g = jnp.sum(grad * sample_mask, dtype=f32)
     root_h = jnp.sum(hess * sample_mask, dtype=f32)
     root_c = jnp.sum(sample_mask, dtype=f32)
-    root_out = leaf_output(root_g, root_h, hp)
     root_fmask = feature_mask if root_allowed is None else \
         feature_mask & root_allowed
-    neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
-    rb_root, fm_root = _node_randomness(node_key, 0, meta, root_fmask,
-                                        extra_trees, ff_bynode)
-    root_split = find_best_split(root_hist, root_g, root_h, root_c,
-                                 meta, hp, fm_root, root_out,
-                                 neg_inf, pos_inf, jnp.int32(0),
-                                 has_categorical, rb_root)
-
-    zero_l = jnp.zeros((L,), f32)
-    leaves = _LeafSplits(
-        sum_grad=zero_l, sum_hess=zero_l, count=zero_l,
-        depth=jnp.zeros((L,), jnp.int32),
-        output=zero_l,
-        gain=jnp.full((L,), K_MIN_SCORE, f32),
-        feature=jnp.zeros((L,), jnp.int32),
-        threshold=jnp.zeros((L,), jnp.int32),
-        default_left=jnp.zeros((L,), jnp.bool_),
-        left_sum_grad=zero_l, left_sum_hess=zero_l, left_count=zero_l,
-        left_output=zero_l, right_output=zero_l,
-        cat_mask=jnp.zeros((L, max_bins), jnp.bool_),
-        min_bound=jnp.full((L,), -jnp.inf, f32),
-        max_bound=jnp.full((L,), jnp.inf, f32),
-    )
-    leaves = _store_split(leaves, 0, root_split, jnp.int32(1), root_out,
-                          root_g, root_h, root_c, neg_inf, pos_inf, True)
-
-    pool = jnp.zeros((L, num_features, max_bins, hist_ops.NUM_HIST_CHANNELS),
-                     f32)
-    pool = pool.at[0].set(root_hist)
+    leaves, pool, used_features = _init_wave_state(
+        root_hist, root_g, root_h, root_c, meta, hp, root_fmask, node_key,
+        L=L, max_bins=max_bins, num_features=num_features, f32=f32,
+        has_categorical=has_categorical, extra_trees=extra_trees,
+        ff_bynode=ff_bynode, interaction_groups=interaction_groups)
     row_leaf = jnp.zeros((num_data,), jnp.int32)
-    used_features = (jnp.zeros((L, num_features), bool)
-                     if interaction_groups is not None else None)
 
-    unknown = SplitInfo(
-        gain=jnp.float32(K_MIN_SCORE), feature=jnp.int32(0),
-        threshold=jnp.int32(0), default_left=jnp.bool_(False),
-        left_sum_grad=jnp.float32(0), left_sum_hess=jnp.float32(0),
-        left_count=jnp.float32(0), right_sum_grad=jnp.float32(0),
-        right_sum_hess=jnp.float32(0), right_count=jnp.float32(0),
-        left_output=jnp.float32(0), right_output=jnp.float32(0),
-        cat_mask=jnp.zeros((max_bins,), jnp.bool_))
+    unknown = _unknown_split(max_bins)
 
     def wave_step(carry, step_idx):
         """Apply one split using STORED candidates only (no histograms).
@@ -996,109 +1190,24 @@ def grow_tree_waved(bins_fm: jax.Array,
         later wave revives growth with fresh candidates, and gap-free
         ids are what Tree.from_arrays and the score updater index by.
         """
-        row_leaf, leaves, used, n_applied, box_lo, box_hi = carry
-        best_leaf = jnp.argmax(leaves.gain).astype(jnp.int32)
-        valid = leaves.gain[best_leaf] > 0.0
-        # invalid steps use the out-of-bounds id L: every .at[] write to
-        # it is dropped (jit scatter semantics), so a dummy can never
-        # clobber a real leaf's slot
-        new_leaf = jnp.where(valid, n_applied + 1, L).astype(jnp.int32)
-        n_applied = n_applied + valid.astype(jnp.int32)
-        feat = leaves.feature[best_leaf]
-        thr = leaves.threshold[best_leaf]
-        dleft = leaves.default_left[best_leaf]
-        cmask = leaves.cat_mask[best_leaf]
-
-        if not use_batched_partition:
+        if use_batched_partition:
+            partition_fn = None
+        else:
             # per-split partition: COO storage can't serve the batched
             # pass's per-row feature gathers, and on CPU the gather is
             # slower than W sequential masked passes (measured: bench
             # fallback 3.6 -> 2.8 s/iter) — the batched pass is an HBM
             # bandwidth optimization for accelerator backends
-            row_leaf = part_ops.apply_split(
-                row_leaf, bins_fm, best_leaf, new_leaf, feat, thr, dleft,
-                cmask, meta.num_bins, meta.missing_type,
-                meta.is_categorical, valid, bundle)
-
-        pg, ph, pc = (leaves.sum_grad[best_leaf], leaves.sum_hess[best_leaf],
-                      leaves.count[best_leaf])
-        lg = leaves.left_sum_grad[best_leaf]
-        lh = leaves.left_sum_hess[best_leaf]
-        lc = leaves.left_count[best_leaf]
-        rg, rh, rc = pg - lg, ph - lh, pc - lc
-        parent_out = leaves.output[best_leaf]
-        p_minb = leaves.min_bound[best_leaf]
-        p_maxb = leaves.max_bound[best_leaf]
-        out_l = leaves.left_output[best_leaf]
-        out_r = leaves.right_output[best_leaf]
-        chosen_gain = leaves.gain[best_leaf]
-
-        if mono_pairwise:
-            # bounds may have tightened since this candidate was stored
-            out_l = jnp.clip(out_l, p_minb, p_maxb)
-            out_r = jnp.clip(out_r, p_minb, p_maxb)
-            box_lo, box_hi = split_ops.split_child_boxes(
-                box_lo, box_hi, best_leaf, new_leaf, feat, thr,
-                meta.is_categorical[feat], valid)
-            out_now = leaves.output.at[best_leaf].set(
-                jnp.where(valid, out_l, parent_out))
-            ni = jnp.minimum(new_leaf, L - 1)
-            out_now = out_now.at[new_leaf].set(
-                jnp.where(valid, out_r, out_now[ni]))
-            leaf_in_use = jnp.arange(L, dtype=jnp.int32) <= n_applied
-            minb_all, maxb_all = split_ops.compute_box_bounds(
-                box_lo, box_hi, out_now, leaf_in_use, meta.monotone)
-            leaves = leaves._replace(
-                min_bound=jnp.where(valid, minb_all, leaves.min_bound),
-                max_bound=jnp.where(valid, maxb_all, leaves.max_bound))
-            l_min, l_max = minb_all[best_leaf], maxb_all[best_leaf]
-            r_min, r_max = minb_all[ni], maxb_all[ni]
-        else:
-            l_min, l_max, r_min, r_max = split_ops.propagate_monotone_bounds(
-                out_l, out_r, meta.monotone[feat].astype(jnp.int32),
-                meta.is_categorical[feat], p_minb, p_maxb)
-
-        if used is not None:
-            child_used = used[best_leaf].at[feat].set(True)
-            used = used.at[best_leaf].set(
-                jnp.where(valid, child_used, used[best_leaf]))
-            used = used.at[new_leaf].set(
-                jnp.where(valid, child_used, used[new_leaf]))
-
-        child_depth = leaves.depth[best_leaf] + 1
-        # children have no candidates until the wave-boundary build
-        leaves = _store_split(leaves, best_leaf, unknown, child_depth,
-                              out_l, lg, lh, lc, l_min, l_max, valid)
-        leaves = _store_split(leaves, new_leaf, unknown, child_depth,
-                              out_r, rg, rh, rc, r_min, r_max, valid)
-
-        left_smaller = lc <= rc
-        record = dict(
-            split_leaf=jnp.where(valid, best_leaf, -1),
-            split_feature=feat,
-            split_bin_threshold=thr,
-            split_default_left=dleft,
-            split_gain=jnp.where(valid, chosen_gain, 0.0),
-            split_cat_mask=cmask,
-            internal_value=parent_out,
-            internal_weight=ph,
-            internal_count=pc,
-        )
-        ys = dict(record=record, valid=valid,
-                  left_id=best_leaf, right_id=new_leaf,
-                  small_id=jnp.where(left_smaller, best_leaf, new_leaf),
-                  left_smaller=left_smaller)
-        return (row_leaf, leaves, used, n_applied, box_lo, box_hi), ys
-
-    def child_candidates(hist, cid, fmask_c, salt, leaves):
-        """find_best_split for one child from its stored stats."""
-        rb, fm = _node_randomness(node_key, salt, meta, fmask_c,
-                                  extra_trees, ff_bynode)
-        return find_best_split(
-            hist, leaves.sum_grad[cid], leaves.sum_hess[cid],
-            leaves.count[cid], meta, hp, fm, leaves.output[cid],
-            leaves.min_bound[cid], leaves.max_bound[cid],
-            leaves.depth[cid] - 1, has_categorical, rb)
+            def partition_fn(row_leaf, best_leaf, new_leaf, feat, thr,
+                             dleft, cmask, valid):
+                return part_ops.apply_split(
+                    row_leaf, bins_fm, best_leaf, new_leaf, feat, thr,
+                    dleft, cmask, meta.num_bins, meta.missing_type,
+                    meta.is_categorical, valid, bundle)
+        return _wave_step_stored(carry, step_idx, L=L, meta=meta, hp=hp,
+                                 unknown=unknown,
+                                 mono_pairwise=mono_pairwise,
+                                 partition_fn=partition_fn)
 
     if batched_partition is None:
         batched_partition = not hist_ops.cpu_backend()
@@ -1156,13 +1265,8 @@ def grow_tree_waved(bins_fm: jax.Array,
         # scatters drop — so the batch has no index collisions.
         if subtract_siblings:
             small_ids = jnp.where(ys["valid"], ys["small_id"], -2)
-            smalls = multi(bins_fm, ghT, row_leaf, small_ids)  # [W, F, B, 3]
-            parents = pool[ys["left_id"]]                      # [W, F, B, 3]
-            small_h = smalls.astype(f32)
-            large_h = hist_ops.subtract_histogram(parents, small_h)
-            ls = ys["left_smaller"][:, None, None, None]
-            left_h = jnp.where(ls, small_h, large_h)
-            right_h = jnp.where(ls, large_h, small_h)
+            wave_hists = multi(bins_fm, ghT, row_leaf,
+                               small_ids)              # [W, F, B, 3]
         else:
             # no-subtraction ORACLE (tpu_wave_subtract=False): build BOTH
             # children directly. Two slots per split — the schedule above
@@ -1171,49 +1275,16 @@ def grow_tree_waved(bins_fm: jax.Array,
             # siblings. Kept as the parity/traffic baseline.
             lids = jnp.where(ys["valid"], ys["left_id"], -2)
             rids = jnp.where(ys["valid"], ys["right_id"], -2)
-            both = multi(bins_fm, ghT, row_leaf,
-                         jnp.concatenate([lids, rids]))
-            left_h = both[:W].astype(f32)
-            right_h = both[W:].astype(f32)
-        left_w = jnp.where(ys["valid"], ys["left_id"], L)
-        right_w = jnp.where(ys["valid"], ys["right_id"], L)
-        pool = pool.at[left_w].set(left_h)
-        pool = pool.at[right_w].set(right_h)
-
-        # --- candidates for the 2W children, batched
-        child_ids = jnp.concatenate([ys["left_id"], ys["right_id"]])
-        child_valid = jnp.concatenate([ys["valid"], ys["valid"]])
-        hists = pool[child_ids]
-        if used_features is not None:
-            fmask_c = feature_mask[None, :] & jax.vmap(
-                _allowed_features, in_axes=(0, None))(
-                    used_features[child_ids], interaction_groups)
-        else:
-            fmask_c = jnp.broadcast_to(feature_mask, (2 * W, num_features))
-        salts = 2 * s0 + jnp.arange(2 * W, dtype=jnp.int32)
-        infos = jax.vmap(child_candidates, in_axes=(0, 0, 0, 0, None))(
-            hists, child_ids, fmask_c, salts, leaves)
-        depth_ok = (max_depth <= 0) | (leaves.depth[child_ids] < max_depth)
-        gains = jnp.where(child_valid & depth_ok, infos.gain, K_MIN_SCORE)
-
-        def upd(arr, val):
-            keep = arr[child_ids]
-            return arr.at[child_ids].set(
-                jnp.where(child_valid.reshape(
-                    (-1,) + (1,) * (val.ndim - 1)), val, keep))
-        leaves = leaves._replace(
-            gain=leaves.gain.at[child_ids].set(
-                jnp.where(child_valid, gains, leaves.gain[child_ids])),
-            feature=upd(leaves.feature, infos.feature),
-            threshold=upd(leaves.threshold, infos.threshold),
-            default_left=upd(leaves.default_left, infos.default_left),
-            left_sum_grad=upd(leaves.left_sum_grad, infos.left_sum_grad),
-            left_sum_hess=upd(leaves.left_sum_hess, infos.left_sum_hess),
-            left_count=upd(leaves.left_count, infos.left_count),
-            left_output=upd(leaves.left_output, infos.left_output),
-            right_output=upd(leaves.right_output, infos.right_output),
-            cat_mask=upd(leaves.cat_mask, infos.cat_mask),
-        )
+            wave_hists = multi(bins_fm, ghT, row_leaf,
+                               jnp.concatenate([lids, rids]))
+        pool, leaves = _wave_boundary_core(
+            pool, leaves, used_features, ys, wave_hists,
+            feature_mask, max_depth, node_key, s0,
+            subtract_siblings=subtract_siblings, L=L,
+            num_features=num_features, f32=f32, meta=meta, hp=hp,
+            interaction_groups=interaction_groups,
+            has_categorical=has_categorical, extra_trees=extra_trees,
+            ff_bynode=ff_bynode)
 
     records = jax.tree_util.tree_map(
         lambda *xs: jnp.concatenate(xs, axis=0), *all_records)
@@ -1244,6 +1315,303 @@ def grow_tree_waved(bins_fm: jax.Array,
         num_leaves=num_leaves_out,
     )
     return tree_arrays, row_leaf
+
+
+class StreamTreeGrower:
+    """Host-orchestrated ``grow_tree_waved`` twin for host-resident bins
+    (out-of-core streaming training, ``tpu_stream``).
+
+    Same split mathematics, wave schedule and traced step/boundary ops
+    as the resident waved grower (the scan body and boundary math are
+    literally shared: ``_wave_step_stored`` / ``_wave_boundary_core`` /
+    ``_init_wave_state``); the difference is WHERE the dominant ``[F,
+    N]`` bin operand lives. Every full-data pass — the root build and
+    each wave's batched partition + boundary histogram build — becomes
+    a loop over ``io.streaming.HostSlabBins`` slabs, with slab k+1's
+    host->device upload double-buffered behind the program consuming
+    slab k (the predict engine's pipeline, factored into
+    ``io/streaming.py``).
+
+    Numerics contract: per-slab partial histograms accumulate in slab
+    order (slab 0 assigns, later slabs add). With a single slab the
+    program consumes the same arrays through the same ops as the
+    resident grower => bit-identical models (asserted in
+    tests/test_stream.py across the sampling matrix). With int32
+    (quantized) histograms the slab partials are exact integer sums
+    that are scaled AFTER accumulation, so ANY slab count is
+    bit-identical to resident. f32 multi-slab accumulation differs
+    from the resident single contraction only by float-add
+    associativity (~1 ulp per boundary add).
+
+    Unsupported (callers gate to the resident grower): EFB bundles,
+    COO sparse storage, forced splits, interaction constraints,
+    pairwise monotone modes, exact (non-waved) growth.
+    """
+
+    def __init__(self, plan, *, num_leaves: int, max_bins: int,
+                 num_features: int, hist_impl: str, hist_precision: str,
+                 has_categorical: bool, extra_trees: bool,
+                 ff_bynode: float, wave_max: int, subtract_siblings: bool,
+                 hist_deterministic: bool):
+        self.plan = plan
+        self.L = int(num_leaves)
+        self.max_bins = int(max_bins)
+        self.num_features = int(num_features)
+        self._impl = hist_impl
+        self._precision = hist_precision
+        self._has_cat = bool(has_categorical)
+        self._extra_trees = bool(extra_trees)
+        self._ff_bynode = float(ff_bynode)
+        self._wave_max = int(wave_max)
+        self._subtract = bool(subtract_siblings)
+        self._deterministic = bool(hist_deterministic)
+        self._progs = {}
+
+    # -- jitted program builders (one callable per kind; jax's jit
+    # caches per input shape, so full slabs and the tail slab simply
+    # specialize the same callable) ------------------------------------
+    def _prog(self, kind: str, builder):
+        prog = self._progs.get(kind)
+        if prog is None:
+            from .obs import xla as obs_xla
+            prog = self._progs[kind] = obs_xla.instrumented_jit(
+                f"stream/{kind}", builder, phase="train")
+        return prog
+
+    def _slab_rows(self, slab) -> int:
+        from .ops.bin_pack import PackedBins
+        return slab.num_data if isinstance(slab, PackedBins) \
+            else int(slab.shape[1])
+
+    def _multi(self, slab, gh_slab, rl_slab, ids):
+        from .ops.pallas_histogram import hist_multi, hist_multi_int8
+        if gh_slab.dtype == jnp.int8:
+            return hist_multi_int8(slab, gh_slab, rl_slab, ids,
+                                   max_bins=self.max_bins,
+                                   num_slots=ids.shape[0],
+                                   impl=self._impl)
+        return hist_multi(slab, gh_slab, rl_slab, ids,
+                          max_bins=self.max_bins,
+                          num_slots=ids.shape[0], impl=self._impl,
+                          precision=self._precision,
+                          deterministic=self._deterministic)
+
+    @staticmethod
+    def _scaled(acc, hscale):
+        """int32 (quantized) accumulators dequantize AFTER the cross-
+        slab sum — exact integer totals, the property that makes the
+        quantized streamed path bit-identical at any slab count."""
+        if acc.dtype == jnp.int32:
+            return acc.astype(jnp.float32) * hscale
+        return acc
+
+    def _gh_slice(self, ghT, lo, n):
+        return lax.dynamic_slice_in_dim(ghT, lo, n, axis=0)
+
+    def _run_hist(self, slab, ghT, rl_slab, lo, ids, acc):
+        """One slab's histogram contribution (root or wave boundary)."""
+        def first(slab_, ghT_, lo_, ids_, rl_):
+            gh = self._gh_slice(ghT_, lo_, self._slab_rows(slab_))
+            return self._multi(slab_, gh, rl_, ids_)
+
+        def nxt(slab_, ghT_, lo_, ids_, rl_, acc_):
+            gh = self._gh_slice(ghT_, lo_, self._slab_rows(slab_))
+            return acc_ + self._multi(slab_, gh, rl_, ids_)
+
+        if acc is None:
+            return self._prog("hist_first", first)(slab, ghT, lo, ids,
+                                                   rl_slab)
+        return self._prog("hist_next", nxt)(slab, ghT, lo, ids, rl_slab,
+                                            acc)
+
+    def _run_wave_slab(self, slab, ghT, rl_slab, lo, wave, ids, acc,
+                       meta, with_hist: bool):
+        """One slab's wave work: batched partition, then (except for
+        the final wave, whose children can never split) the boundary
+        histogram contribution — one upload serves both."""
+        def part(slab_, rl_, wave_, meta_):
+            return part_ops.apply_wave_splits(
+                rl_, slab_, wave_["left_id"], wave_["right_id"],
+                wave_["feat"], wave_["thr"], wave_["dleft"],
+                wave_["cmask"], wave_["valid"], meta_.num_bins,
+                meta_.missing_type, meta_.is_categorical, self.L, None)
+
+        if not with_hist:
+            return self._prog("wave_last", part)(slab, rl_slab, wave,
+                                                 meta), None
+
+        def part_hist_first(slab_, ghT_, rl_, lo_, wave_, ids_, meta_):
+            new_rl = part(slab_, rl_, wave_, meta_)
+            gh = self._gh_slice(ghT_, lo_, self._slab_rows(slab_))
+            return new_rl, self._multi(slab_, gh, new_rl, ids_)
+
+        def part_hist_next(slab_, ghT_, rl_, lo_, wave_, ids_, meta_,
+                           acc_):
+            new_rl = part(slab_, rl_, wave_, meta_)
+            gh = self._gh_slice(ghT_, lo_, self._slab_rows(slab_))
+            return new_rl, acc_ + self._multi(slab_, gh, new_rl, ids_)
+
+        if acc is None:
+            return self._prog("wave_first", part_hist_first)(
+                slab, ghT, rl_slab, lo, wave, ids, meta)
+        return self._prog("wave_next", part_hist_next)(
+            slab, ghT, rl_slab, lo, wave, ids, meta, acc)
+
+    def _run_wave_apply(self, leaves, n_applied, steps, meta, hp):
+        unknown = _unknown_split(self.max_bins)
+
+        def wave_apply(leaves_, n_applied_, steps_, meta_, hp_):
+            def step(carry, s):
+                return _wave_step_stored(carry, s, L=self.L, meta=meta_,
+                                         hp=hp_, unknown=unknown,
+                                         mono_pairwise=False,
+                                         partition_fn=None)
+            carry, ys = lax.scan(
+                step, (jnp.int32(0), leaves_, None, n_applied_, None,
+                       None), steps_)
+            return carry[1], carry[3], ys
+
+        return self._prog("wave_apply", wave_apply)(leaves, n_applied,
+                                                    steps, meta, hp)
+
+    def _run_root_finish(self, acc, hscale, root_g, root_h, root_c,
+                         fmask, node_key, meta, hp):
+        def root_finish(acc_, hscale_, rg, rh, rc, fmask_, node_key_,
+                        meta_, hp_):
+            root_hist = self._scaled(acc_, hscale_)[0].astype(jnp.float32)
+            leaves, pool, _ = _init_wave_state(
+                root_hist, rg, rh, rc, meta_, hp_, fmask_, node_key_,
+                L=self.L, max_bins=self.max_bins,
+                num_features=self.num_features, f32=jnp.float32,
+                has_categorical=self._has_cat,
+                extra_trees=self._extra_trees, ff_bynode=self._ff_bynode,
+                interaction_groups=None)
+            return leaves, pool
+
+        return self._prog("root_finish", root_finish)(
+            acc, hscale, root_g, root_h, root_c, fmask, node_key, meta,
+            hp)
+
+    def _run_boundary(self, acc, hscale, pool, leaves, ys, fmask,
+                      max_depth, node_key, s0, meta, hp):
+        def boundary(acc_, hscale_, pool_, leaves_, ys_, fmask_,
+                     max_depth_, node_key_, s0_, meta_, hp_):
+            wave_hists = self._scaled(acc_, hscale_)
+            return _wave_boundary_core(
+                pool_, leaves_, None, ys_, wave_hists, fmask_,
+                max_depth_, node_key_, s0_,
+                subtract_siblings=self._subtract,
+                L=self.L, num_features=self.num_features,
+                f32=jnp.float32, meta=meta_, hp=hp_,
+                interaction_groups=None, has_categorical=self._has_cat,
+                extra_trees=self._extra_trees, ff_bynode=self._ff_bynode)
+
+        return self._prog("boundary", boundary)(
+            acc, hscale, pool, leaves, ys, fmask, max_depth, node_key,
+            s0, meta, hp)
+
+    # -- the grower -----------------------------------------------------
+    def grow(self, ghT, hscale, root_sums, feature_mask, meta, hp,
+             max_depth, node_key=None):
+        """Grow one tree over the host-resident slab plan.
+
+        ghT: device ``[N, 3]`` pre-masked (g, h, m) operand — f32, or
+        int8 with ``hscale`` the [3] dequantization vector (f32 passes
+        ``hscale=ones``, applied only on int32 accumulators).
+        root_sums: (root_g, root_h, root_c) scalars, computed by the
+        caller's prep program from the SAME masked gradients.
+        Returns (TreeArrays, row_leaf [N]) like the resident growers.
+        """
+        plan = self.plan
+        stats = plan.stats
+        root_g, root_h, root_c = root_sums
+        root_ids = jnp.zeros((1,), jnp.int32)
+
+        # --- root histogram: one pass over the slabs
+        acc = None
+        for i, slab in plan.feed():
+            lo = jnp.int32(plan.bounds[i][0])
+            rl0 = jnp.zeros((self._slab_rows(slab),), jnp.int32)
+            acc = self._run_hist(slab, ghT, rl0, lo, root_ids, acc)
+            stats.note_dispatch()
+        leaves, pool = self._run_root_finish(
+            acc, hscale, root_g, root_h, root_c, feature_mask, node_key,
+            meta, hp)
+
+        rl_slabs = None  # per-slab row->leaf pieces (lazily zeros)
+        n_applied = jnp.int32(0)
+        all_records, all_valid = [], []
+        s0 = 0
+        schedule = _wave_schedule(self.L, self._wave_max, HIST_SLOTS,
+                                  1 if self._subtract else 2)
+        for wi, W in enumerate(schedule):
+            steps = jnp.arange(s0, s0 + W, dtype=jnp.int32)
+            leaves, n_applied, ys = self._run_wave_apply(
+                leaves, n_applied, steps, meta, hp)
+            all_records.append(ys["record"])
+            all_valid.append(ys["valid"])
+            s0 += W
+            last = wi == len(schedule) - 1
+            if self._subtract:
+                ids = jnp.where(ys["valid"], ys["small_id"], -2)
+            else:
+                ids = jnp.concatenate(
+                    [jnp.where(ys["valid"], ys["left_id"], -2),
+                     jnp.where(ys["valid"], ys["right_id"], -2)])
+            wave = {"left_id": ys["left_id"], "right_id": ys["right_id"],
+                    "feat": ys["record"]["split_feature"],
+                    "thr": ys["record"]["split_bin_threshold"],
+                    "dleft": ys["record"]["split_default_left"],
+                    "cmask": ys["record"]["split_cat_mask"],
+                    "valid": ys["valid"]}
+            acc = None
+            new_rls = []
+            for i, slab in plan.feed():
+                lo_i, hi_i = plan.bounds[i]
+                rl = (rl_slabs[i] if rl_slabs is not None else
+                      jnp.zeros((hi_i - lo_i,), jnp.int32))
+                rl2, acc = self._run_wave_slab(
+                    slab, ghT, rl, jnp.int32(lo_i), wave, ids, acc,
+                    meta, with_hist=not last)
+                new_rls.append(rl2)
+                stats.note_dispatch()
+            rl_slabs = new_rls
+            stats.waves_total += 1
+            if last:
+                # the tree is full: the final wave's children can never
+                # split, so the boundary pass is skipped — same as the
+                # resident grower
+                break
+            pool, leaves = self._run_boundary(
+                acc, hscale, pool, leaves, ys, feature_mask, max_depth,
+                node_key, jnp.int32(s0), meta, hp)
+
+        # --- assemble (same compaction as the resident grower)
+        records = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *all_records)
+        valid_all = jnp.concatenate(all_valid)
+        steps_all = jnp.arange(self.L - 1, dtype=jnp.int32)
+        order = jnp.argsort(jnp.where(valid_all, steps_all,
+                                      steps_all + self.L))
+        records = jax.tree_util.tree_map(lambda a: a[order], records)
+        row_leaf = (rl_slabs[0] if len(rl_slabs) == 1
+                    else jnp.concatenate(rl_slabs))
+        tree_arrays = TreeArrays(
+            split_leaf=records["split_leaf"],
+            split_feature=records["split_feature"],
+            split_bin_threshold=records["split_bin_threshold"],
+            split_default_left=records["split_default_left"],
+            split_gain=records["split_gain"],
+            split_cat_mask=records["split_cat_mask"],
+            internal_value=records["internal_value"],
+            internal_weight=records["internal_weight"],
+            internal_count=records["internal_count"],
+            leaf_value=leaves.output,
+            leaf_weight=leaves.sum_hess,
+            leaf_count=leaves.count,
+            num_leaves=1 + n_applied,
+        )
+        return tree_arrays, row_leaf
 
 
 def replay_tree(tree: TreeArrays, bins_fm, meta: FeatureMeta, bundle=None,

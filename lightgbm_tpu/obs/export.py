@@ -223,6 +223,40 @@ def render_openmetrics(registry=None,
         doc.sample("lgbmtpu_continual_mesh_resizes_total", "counter",
                    ct.get("mesh_resizes", 0))
 
+    # out-of-core streaming accounting (io/streaming.py StreamStats,
+    # published per iteration by the streamed boosting paths): the
+    # driver-visible proof that slab uploads overlap the histogram
+    # kernels without silicon counters
+    sm = meta.get("stream")
+    if isinstance(sm, dict) and sm.get("slabs_total"):
+        doc.sample("lgbmtpu_stream_slabs_total", "counter",
+                   sm.get("slabs_total", 0),
+                   help_text="host-resident bin slabs fed to the device "
+                             "(tpu_stream out-of-core training)")
+        doc.sample("lgbmtpu_stream_uploads_total", "counter",
+                   sm.get("uploads_total", 0))
+        doc.sample("lgbmtpu_stream_bytes_uploaded_total", "counter",
+                   sm.get("bytes_uploaded_total", 0))
+        doc.sample("lgbmtpu_stream_upload_seconds_total", "counter",
+                   sm.get("upload_seconds_total", 0.0))
+        doc.sample("lgbmtpu_stream_overlapped_uploads_total", "counter",
+                   sm.get("overlapped_uploads_total", 0))
+        doc.sample("lgbmtpu_stream_kernel_seconds_total", "counter",
+                   sm.get("kernel_seconds_total", 0.0),
+                   help_text="host wall time blocked on streamed-"
+                             "pipeline device compute")
+        doc.sample("lgbmtpu_stream_iterations_total", "counter",
+                   sm.get("iterations_total", 0))
+        doc.sample("lgbmtpu_stream_overlap_ratio", "gauge",
+                   sm.get("overlap_ratio", 0.0),
+                   help_text="fraction of upload wall-time issued while "
+                             "device compute was in flight (the "
+                             "double-buffer's measured overlap)")
+        doc.sample("lgbmtpu_stream_slab_rows", "gauge",
+                   sm.get("slab_rows", 0))
+        doc.sample("lgbmtpu_stream_n_slabs", "gauge",
+                   sm.get("n_slabs", 0))
+
     # XLA introspection (obs/xla.py; populated while enabled)
     from .xla import global_xla
     xs = global_xla.summary()

@@ -119,7 +119,8 @@ def train_memory_model(*, num_data: int, num_features: int, max_bins: int,
                        fused_grad: bool = False, kernel_fused: bool = False,
                        waved: bool = True, wave_max: int = 42,
                        num_shards: int = 1, has_weight: bool = False,
-                       valid_rows: Sequence[int] = ()) -> Dict[str, Any]:
+                       valid_rows: Sequence[int] = (),
+                       stream_slab_rows: int = 0) -> Dict[str, Any]:
     """Analytic per-device peak-HBM model of one training run.
 
     Accounts every buffer class the fused iteration program keeps
@@ -157,7 +158,14 @@ def train_memory_model(*, num_data: int, num_features: int, max_bins: int,
     k = max(int(num_class), 1)
 
     comp: Dict[str, int] = {}
-    comp["bins"] = packed_bin_bytes(n_s, f, b, pack_vpb)
+    slab = int(stream_slab_rows)
+    if slab > 0:
+        # out-of-core streaming (tpu_stream): the [F, N] bin tensor is
+        # HOST-resident; device HBM holds only the double-buffered slab
+        # pair (slab k being consumed + slab k+1 uploading)
+        comp["bins"] = 2 * packed_bin_bytes(min(slab, n_s), f, b, pack_vpb)
+    else:
+        comp["bins"] = packed_bin_bytes(n_s, f, b, pack_vpb)
     comp["scores"] = k * n_s * F32
     comp["objective"] = n_s * F32 * (2 if has_weight else 1)
     comp["sample_mask"] = n_s * F32
@@ -212,6 +220,7 @@ def train_memory_model(*, num_data: int, num_features: int, max_bins: int,
         "peak_bytes": phases[peak_phase],
         "peak_phase": peak_phase,
         "num_shards": shards,
+        "stream_slab_rows": slab,
         "params": dict(num_data=n, num_features=f, max_bins=b,
                        num_leaves=l, num_class=k,
                        num_iterations=int(num_iterations),
@@ -220,8 +229,38 @@ def train_memory_model(*, num_data: int, num_features: int, max_bins: int,
                        kernel_fused=bool(kernel_fused), waved=bool(waved),
                        wave_max=int(wave_max), num_shards=shards,
                        has_weight=bool(has_weight),
-                       valid_rows=[int(v) for v in (valid_rows or ())]),
+                       valid_rows=[int(v) for v in (valid_rows or ())],
+                       stream_slab_rows=slab),
     }
+
+
+def stream_auto_slab_rows(kw: Dict[str, Any],
+                          capacity_bytes: Optional[int]) -> int:
+    """Auto slab size for out-of-core streaming (``tpu_stream`` with
+    ``tpu_stream_slab_rows=0``): the largest section-aligned row count
+    whose DOUBLE-BUFFERED slab pair fits the capacity left after the
+    resident (non-bins) working set of the analytic model. Unknown
+    capacity (CPU, no LGBM_TPU_HBM_BYTES) => one slab covering all
+    rows — the degenerate plan that is bit-identical to resident
+    training by construction. Never returns less than one aligned
+    section even when nothing fits (preflight reports the shortfall
+    separately)."""
+    from ..ops.bin_pack import slab_align
+    kw = {k: v for k, v in kw.items() if k != "stream_slab_rows"}
+    n = int(kw["num_data"])
+    align = slab_align(int(kw["max_bins"]))
+    if capacity_bytes is None:
+        return -(-n // align) * align
+    resident = train_memory_model(**kw)
+    non_bins = resident["peak_bytes"] - resident["components"]["bins"]
+    budget = max(int(capacity_bytes) - non_bins, 0)
+    bytes_per_row = max(
+        packed_bin_bytes(align, int(kw["num_features"]),
+                         int(kw["max_bins"]), int(kw["pack_vpb"])) / align,
+        1e-9)
+    rows = int(budget / (2 * bytes_per_row))
+    rows = max(rows // align * align, align)
+    return min(rows, -(-n // align) * align)
 
 
 def _resolve_train_knobs(config, num_data: int, num_features: int,
@@ -343,7 +382,8 @@ class PreflightReport:
     that knob applied, so the numbers are projections, not guesses."""
 
     def __init__(self, model: Dict[str, Any], capacity_bytes: Optional[int],
-                 recommendations: List[Dict[str, Any]]):
+                 recommendations: List[Dict[str, Any]],
+                 stream: Optional[Dict[str, Any]] = None):
         self.model = model
         self.peak_bytes = int(model["peak_bytes"])
         self.capacity_bytes = capacity_bytes
@@ -352,6 +392,14 @@ class PreflightReport:
         self.headroom_bytes = (None if capacity_bytes is None
                                else int(capacity_bytes) - self.peak_bytes)
         self.recommendations = recommendations
+        # out-of-core streaming verdict (training reports): `fits` stays
+        # the RESIDENT verdict — honest about what a non-streamed run
+        # would do — while `fits_streaming` says whether the tpu_stream
+        # working set (host bins, double-buffered slab) fits. None when
+        # capacity is unknown or the shape is stream-ineligible.
+        self.stream = stream
+        self.fits_streaming = (None if stream is None
+                               else bool(stream.get("fits")))
 
     def render(self) -> str:
         gb = 1e9
@@ -361,15 +409,18 @@ class PreflightReport:
                  f"(phase: {self.model.get('peak_phase')}), "
                  f"device capacity {cap}"]
         if self.fits is False:
-            lines[0] += " — DOES NOT FIT"
+            lines[0] += " — DOES NOT FIT resident"
             for r in self.recommendations:
+                setting = r["setting"]
+                extra = (f" (slab_rows={r['slab_rows']})"
+                         if "slab_rows" in r else "")
                 lines.append(
-                    f"  try {r['knob']}={r['setting']}: predicted peak "
+                    f"  try {r['knob']}={setting}{extra}: predicted peak "
                     f"{r['peak_bytes'] / gb:.2f} GB "
                     f"(saves {r['saves_bytes'] / gb:.2f} GB) — {r['reason']}")
             if not self.recommendations:
                 lines.append("  no single knob closes the gap; shrink the "
-                             "dataset or stream it (ROADMAP item 2)")
+                             "dataset or shard it over more hosts")
         return "\n".join(lines)
 
 
@@ -383,7 +434,8 @@ def _rec(knob: str, setting, base_peak: int, model: Dict[str, Any],
 
 
 def _train_recommendations(kw: Dict[str, Any],
-                           capacity: Optional[int]) -> List[Dict[str, Any]]:
+                           capacity: Optional[int],
+                           stream_ok: bool = True) -> List[Dict[str, Any]]:
     """Knob projections that shrink the training peak, computed by
     re-running the model with one knob flipped at a time."""
     from ..ops.bin_pack import pack_vpb as _pack_vpb
@@ -442,23 +494,115 @@ def _train_recommendations(kw: Dict[str, Any],
                      "(tree_learner=data)")
             if r:
                 recs.append(r)
+    if stream_ok:
+        sm = stream_model(kw, capacity)
+        r = _rec("tpu_stream", "on", base, sm["model"],
+                 "keep bins host-resident and stream section-aligned "
+                 "slabs through the histogram waves (io/streaming.py)")
+        if r:
+            r["slab_rows"] = sm["slab_rows"]
+            recs.append(r)
     recs.sort(key=lambda r: -r["saves_bytes"])
     return recs
 
 
+def stream_model(kw: Dict[str, Any],
+                 capacity: Optional[int]) -> Dict[str, Any]:
+    """The analytic model of the SAME shape trained out-of-core
+    (tpu_stream): auto slab size + the streamed peak, with a fits
+    verdict against `capacity`. Streaming keeps gradients materialized
+    (the streamed prep program needs the [N] buffers), so fused-grad
+    components are forced off."""
+    kw = {**kw, "fused_grad": False, "kernel_fused": False}
+    kw.pop("stream_slab_rows", None)
+    slab = stream_auto_slab_rows(kw, capacity)
+    model = train_memory_model(**kw, stream_slab_rows=slab)
+    fits = (None if capacity is None
+            else model["peak_bytes"] <= int(capacity))
+    return {"model": model, "slab_rows": int(slab),
+            "peak_bytes": int(model["peak_bytes"]), "fits": fits}
+
+
+def stream_config_ineligible(config,
+                             num_class: Optional[int] = None
+                             ) -> Optional[str]:
+    """Why a CONFIG cannot stream out-of-core, or None. This is THE
+    config-level gate list — ``GBDT._stream_ineligible`` delegates to
+    it (adding the storage-level gates only a built dataset knows: EFB
+    bundling, COO sparsity), so ``preflight``'s recommendation and the
+    booster's resolve decision cannot drift. A recommendation may still
+    be optimistic about storage (preflight sees shapes, not bins)."""
+    if getattr(config, "forcedsplits_filename", ""):
+        return "forced splits need the exact (non-waved) grower"
+    if getattr(config, "interaction_constraints", None):
+        return "interaction constraints are not streamed"
+    if bool(getattr(config, "linear_tree", False)):
+        return "linear trees fit per-leaf models from raw rows"
+    if getattr(config, "monotone_constraints", None) and \
+            str(getattr(config, "monotone_constraints_method", "basic")) \
+            in ("intermediate", "advanced"):
+        return "pairwise monotone modes are not streamed"
+    wm = int(getattr(config, "tpu_wave_max", -1))
+    k = int(num_class if num_class is not None
+            else getattr(config, "num_class", 1))
+    coupled = k > 1 and str(getattr(config, "objective", "")) \
+        != "multiclassova"
+    if wm == 0 or (wm < 0 and coupled):
+        return ("exact-order growth (tpu_wave_max=0; coupled "
+                "multiclass objectives resolve to it) has no "
+                "streamed twin")
+    learner = str(getattr(config, "tree_learner", "serial"))
+    if learner not in ("serial", "data"):
+        return (f"tree_learner={learner} replaces the grower with its "
+                "own adapter")
+    try:
+        import jax
+        if jax.process_count() > 1:
+            return ("multi-host training assembles globally-sharded "
+                    "bins (per-host slab plans are not wired yet)")
+    except RuntimeError:
+        pass  # backend not initialized: single-process
+    return None
+
+
+def stream_config_eligible(config) -> bool:
+    """True when the config admits out-of-core streaming AND the
+    ``tpu_stream`` knob is not off — the screen ``preflight`` uses to
+    decide whether a streaming recommendation/verdict is on the table."""
+    if str(getattr(config, "tpu_stream", "auto")).lower() in (
+            "off", "0", "false", "none"):
+        return False
+    return stream_config_ineligible(config) is None
+
+
 def train_report(kw: Dict[str, Any],
-                 capacity_bytes: Optional[int] = None) -> PreflightReport:
+                 capacity_bytes: Optional[int] = None,
+                 stream_ok: bool = True) -> PreflightReport:
     """PreflightReport for already-resolved model kwargs — the entry the
     booster hook uses (it knows the ACTUAL resolved knobs: pack factor,
     fused/quantized state, mesh size), while ``preflight`` resolves them
-    from a config for the before-any-allocation path."""
+    from a config for the before-any-allocation path.
+
+    ``stream_ok``: the shape/config admits out-of-core streaming; the
+    report then carries the streamed-model verdict (``fits_streaming``)
+    and a ``tpu_stream`` recommendation when resident does not fit."""
     model = train_memory_model(**kw)
     cap = capacity_bytes if capacity_bytes is not None \
         else device_capacity_bytes()
     recs: List[Dict[str, Any]] = []
+    stream = None
+    active_slab = int(kw.get("stream_slab_rows", 0) or 0)
+    if active_slab > 0:
+        # the caller's model already IS the streamed one (tpu_stream on)
+        stream = {"model": model, "slab_rows": active_slab,
+                  "peak_bytes": int(model["peak_bytes"]),
+                  "fits": (None if cap is None
+                           else model["peak_bytes"] <= int(cap))}
+    elif stream_ok:
+        stream = stream_model(kw, cap)
     if cap is not None and model["peak_bytes"] > cap:
-        recs = _train_recommendations(kw, cap)
-    return PreflightReport(model, cap, recs)
+        recs = _train_recommendations(kw, cap, stream_ok=stream_ok)
+    return PreflightReport(model, cap, recs, stream=stream)
 
 
 def preflight(params=None, shape: Optional[Tuple[int, int]] = None, *,
@@ -481,7 +625,8 @@ def preflight(params=None, shape: Optional[Tuple[int, int]] = None, *,
     k = int(num_class if num_class is not None else params.num_class)
     kw = _resolve_train_knobs(params, n_rows, n_features, k)
     kw["valid_rows"] = list(valid_rows or ())
-    return train_report(kw, capacity_bytes)
+    return train_report(kw, capacity_bytes,
+                        stream_ok=stream_config_eligible(params))
 
 
 def preflight_predict(*, num_rows: int, num_features: int, num_trees: int,

@@ -113,6 +113,42 @@ def to_device(pb: PackedBins) -> PackedBins:
     return PackedBins(jnp.asarray(pb.data), pb.num_data, pb.vpb)
 
 
+# ---------------------------------------------------------------------------
+# slab slicing (out-of-core streaming training, io/streaming.HostSlabBins)
+def slab_align(max_bins: int) -> int:
+    """Row-count alignment of a streaming slab: each slab is packed as
+    its OWN section-aligned PackedBins (section a PACK_ALIGN multiple),
+    so a slab whose row count is a multiple of ``vpb * PACK_ALIGN``
+    packs with zero padding waste and every full slab shares one device
+    shape (one compiled slab program, not one per slab)."""
+    return pack_vpb(max_bins) * PACK_ALIGN
+
+
+def slab_bounds(num_data: int, slab_rows: int, max_bins: int):
+    """Cut ``num_data`` rows into section-aligned ``[lo, hi)`` slabs.
+    ``slab_rows`` is rounded UP to the slab alignment; the tail slab
+    keeps its natural (shorter) row count — consumers mask by
+    ``num_data`` exactly like the resident packed path does."""
+    align = slab_align(max_bins)
+    rows = max(int(slab_rows), 1)
+    rows = -(-rows // align) * align
+    return [(lo, min(lo + rows, int(num_data)))
+            for lo in range(0, int(num_data), rows)]
+
+
+def pack_bins_range(bins_fm: np.ndarray, max_bins: int, lo: int, hi: int,
+                    pack: bool = True):
+    """Host storage of rows ``[lo, hi)`` as a streaming slab: a
+    section-aligned ``PackedBins`` when ``pack`` and the bin width
+    admits packing, else the raw uint8/uint16 row slice. The slab is
+    self-contained — its section layout is its own, so every device
+    consumer (histogram kernels, partition unpack) treats it exactly
+    like a full resident matrix of ``hi - lo`` rows."""
+    sub = np.ascontiguousarray(bins_fm[:, lo:hi])
+    packed = pack_bins_host(sub, max_bins) if pack else None
+    return packed if packed is not None else sub
+
+
 def unpack_bins(pb: PackedBins):
     """``PackedBins -> [F, N]`` logical bins (jnp; XLA fuses the
     shift/mask into consumers, so the HBM read stays the packed

@@ -599,13 +599,14 @@ def predict_raw_cached(owner, trees: List, num_tree_per_iteration: int,
     t0 = time.perf_counter()
     with global_tracer.span("predict/traversal"):
         parts = []
-        cur = stage(*bounds[0])
-        for i in range(len(bounds)):
-            # double-buffer: chunk i+1's transfer overlaps chunk i's
-            # traversal (device_put and the jitted call are both async)
-            nxt = stage(*bounds[i + 1]) if i + 1 < len(bounds) else None
-            parts.append((prog(*arrs, cur[0]), cur[1]))
-            cur = nxt
+        # double-buffer: chunk i+1's transfer overlaps chunk i's
+        # traversal (device_put and the jitted call are both async) —
+        # the shared pipeline implementation in io/streaming.py, also
+        # used by out-of-core training's slab feed
+        from ..io.streaming import double_buffered
+        for dev, rows in double_buffered(bounds,
+                                         lambda b: stage(*b)):
+            parts.append((prog(*arrs, dev), rows))
         out = np.concatenate(
             [np.asarray(y, np.float64)[:rows] for y, rows in parts], axis=0)
     global_metrics.note_predict(n, time.perf_counter() - t0)

@@ -77,6 +77,23 @@ class _DataParallelMixin:
             if self.mesh.size > 1:
                 self._build_grow_sharded()
             return
+        if self._stream is not None:
+            # out-of-core streaming: bins stay HOST-resident; only the
+            # row-indexed device state shards. Slab uploads land
+            # row-sharded over the data axis (HostSlabBins.stage) and
+            # the XLA histogram contraction partitions under GSPMD —
+            # the grower is rebuilt with the mesh below.
+            self.scores = mesh_lib.shard_data(self.mesh, self.scores,
+                                              row_axis=1)
+            self._sample_mask = mesh_lib.shard_data(
+                self.mesh, self._sample_mask, row_axis=0)
+            self.feature_meta = jax.tree_util.tree_map(
+                lambda a: mesh_lib.replicate(self.mesh, a),
+                self.feature_meta)
+            if self.mesh.size > 1:
+                self._stream.mesh = self.mesh
+                self._build_grow_sharded()
+            return
         # bins [F, N]: rows sharded, features replicated
         self.bins_fm = mesh_lib.shard_data(self.mesh, self.bins_fm, row_axis=1)
         # scores [K, N]: rows sharded

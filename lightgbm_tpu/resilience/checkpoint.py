@@ -138,6 +138,13 @@ def _fingerprint(gbdt) -> Dict[str, Any]:
         # drifting — a resized-mesh restore is a named event, not a
         # silent accident
         "mesh_shards": mesh_shards_of(gbdt),
+        # out-of-core slab plan (tpu_stream): a resume whose slab size
+        # drifted (e.g. a different LGBM_TPU_HBM_BYTES) would silently
+        # change the f32 slab-accumulation order mid-run — refuse it
+        # like any other structural drift
+        "stream_slab_rows": (int(gbdt._stream.slab_rows)
+                             if getattr(gbdt, "_stream", None) is not None
+                             else 0),
     }
 
 

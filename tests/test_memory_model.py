@@ -171,6 +171,7 @@ class TestPreflight:
         assert r.fits is False
         assert r.recommendations, "a non-fit must carry recommendations"
         known_knobs = {"tpu_bin_pack", "max_bin", "use_quantized_grad",
+                       "tpu_stream",
                        "tpu_fused_grad", "tpu_num_shards"}
         for rec in r.recommendations:
             assert rec["knob"] in known_knobs

@@ -445,9 +445,11 @@ def check_resilience_overhead(floor, failures, lines):
               f"{n} snapshot(s) (ceiling {max_share:.0%})")
 
 
-def _load_continual_records(candidate_path=None):
-    """[(tag, record)] for every bench line carrying a `continual`
-    summary (bench.py --continual), oldest first; candidate last."""
+def _load_keyed_records(key, candidate_path=None):
+    """[(tag, record)] for every bench line carrying a `key` summary
+    dict (bench.py --continual / --stream), oldest first; candidate
+    last. Accepts both a bare record blob and the driver's n/cmd/rc/
+    tail wrapper (the summary line is fished out of the tail)."""
     out = []
     paths = sorted(glob.glob(os.path.join(REPO, "BENCH_*.json")))
     if candidate_path and os.path.exists(candidate_path):
@@ -459,22 +461,26 @@ def _load_continual_records(candidate_path=None):
         except (OSError, ValueError):
             continue
         rec = None
-        if isinstance(blob.get("continual"), dict):
+        if isinstance(blob.get(key), dict):
             rec = blob
         else:
             for line in reversed(str(blob.get("tail", "")).splitlines()):
                 line = line.strip()
-                if line.startswith("{") and '"continual"' in line:
+                if line.startswith("{") and f'"{key}"' in line:
                     try:
                         cand = json.loads(line)
                     except ValueError:
                         continue
-                    if isinstance(cand.get("continual"), dict):
+                    if isinstance(cand.get(key), dict):
                         rec = cand
                         break
         if rec is not None:
             out.append((os.path.basename(path), rec))
     return out
+
+
+def _load_continual_records(candidate_path=None):
+    return _load_keyed_records("continual", candidate_path)
 
 
 def check_continual_overhead(floor, failures, candidate_path=None):
@@ -524,6 +530,56 @@ def check_continual_overhead(floor, failures, candidate_path=None):
               f"overhead share {overhead_share:.2%} over {gens} "
               f"generation(s), {int(ct.get('rollbacks', 0))} "
               f"rollback(s) (ceilings {max_swap:.0%}/{max_overhead:.0%})")
+
+
+def check_stream_overhead(floor, failures, candidate_path=None):
+    """Out-of-core streaming ceilings (check 9): over the latest bench
+    record carrying a `stream` summary (bench.py --stream), the
+    streamed run may not be more than the floor-configured factor
+    slower than the same-run resident anchor, and the measured
+    upload/compute overlap ratio must clear its floor — streaming is
+    only a win if the double buffer actually hides the slab uploads.
+    No streaming bench recorded => the check reports itself skipped."""
+    cfg = floor.get("stream")
+    if not cfg:
+        print("# no stream floor recorded; stream-overhead check skipped")
+        return
+    recs = _load_keyed_records("stream", candidate_path)
+    if not recs:
+        print("# no streaming bench recorded; stream-overhead check "
+              "skipped")
+        return
+    tag, rec = recs[-1]
+    sm = rec["stream"]
+    vs_resident = float(sm.get("vs_resident",
+                               rec.get("vs_baseline", 0.0)) or 0.0)
+    overlap = float(sm.get("stream_overlap_ratio",
+                           sm.get("overlap_ratio", 0.0)) or 0.0)
+    n_slabs = int(sm.get("n_slabs", 0))
+    if vs_resident <= 0.0:
+        print(f"# stream[{tag}]: no resident anchor recorded; "
+              "stream-overhead check skipped")
+        return
+    platform = _platform_of(rec.get("unit", ""))
+    key = f"max_overhead_vs_resident_{platform}"
+    max_overhead = float(cfg.get(key, cfg.get("max_overhead_vs_resident",
+                                              1.25)))
+    min_overlap = float(cfg.get("min_overlap_ratio", 0.05))
+    slowdown = 1.0 / vs_resident
+    if slowdown > max_overhead:
+        failures.append(
+            f"{tag}: streamed training is {slowdown:.2f}x the resident "
+            f"wall-time ({n_slabs} slabs, platform={platform}); ceiling "
+            f"{max_overhead:.2f}x")
+    if overlap < min_overlap:
+        failures.append(
+            f"{tag}: stream overlap ratio {overlap:.2%} is under the "
+            f"{min_overlap:.0%} floor — uploads are not hiding behind "
+            "device compute")
+    if slowdown <= max_overhead and overlap >= min_overlap:
+        print(f"# stream[{tag}]: {slowdown:.2f}x resident "
+              f"({n_slabs} slabs), overlap {overlap:.2%} "
+              f"(ceilings {max_overhead:.2f}x / >={min_overlap:.0%})")
 
 
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
@@ -582,6 +638,7 @@ def main(argv=None) -> int:
     check_health_summaries(floor, failures, lines)
     check_resilience_overhead(floor, failures, lines)
     check_continual_overhead(floor, failures, candidate)
+    check_stream_overhead(floor, failures, candidate)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")
