@@ -377,6 +377,13 @@ class Booster:
         self.config = Config.from_params(self.params)
         from . import log
         log.set_verbosity(self.config.verbosity)
+        # warm start by default: arm the persistent XLA compile cache at
+        # THE training program boundary (compile_cache.py policy — a
+        # second process re-running the same shapes pays ~zero compile
+        # seconds). No-op when conftest/env/operator already armed one.
+        from .compile_cache import configure as _configure_compile_cache
+        _configure_compile_cache(self.config.tpu_compile_cache,
+                                 self.config.tpu_compile_cache_dir or None)
         if self.config.trace_output:
             # param twin of LGBM_TPU_TRACE: record spans for this run and
             # write a Chrome trace at exit (obs/trace.py)

@@ -1,0 +1,225 @@
+"""Persistent XLA compile-cache policy — warm start as the default.
+
+The one real-silicon datapoint (BENCH_r02) paid 108.9 s of
+warmup+compile before the first useful iteration and CPU runs pay
+~29 s, yet until this module the persistent compilation cache existed
+only in ``hostenv.cpu_child_env`` (driver helper children) and the test
+conftest: a real training or serving process recompiled every program
+from scratch. This module is the ONE place that policy lives now, and
+every program-entry boundary routes through it:
+
+- ``Booster.__init__`` / ``engine.train`` / ``engine.cv`` (training),
+- ``serve.ModelRegistry`` / ``serve_file`` (serving),
+- ``bench.py`` measurement children and ``hostenv.cpu_child_env``.
+
+``configure(mode, cache_dir)`` arms ``jax.config.jax_compilation_cache_dir``:
+
+- ``auto`` (the ``tpu_compile_cache`` default): enable the cache at the
+  default directory unless something already configured one — an
+  existing ``jax.config`` setting or ``JAX_COMPILATION_CACHE_DIR`` env
+  is respected, so tests/conftest and operator overrides win.
+- ``on``: force the cache to ``cache_dir`` (or the default directory),
+  replacing any prior setting.
+- ``off``: never touch jax config (an already-armed cache is left
+  alone — "off" opts this entry point out, it does not disarm others).
+
+Directory resolution: explicit ``cache_dir`` argument >
+``LGBM_TPU_COMPILE_CACHE_DIR`` env > ``JAX_COMPILATION_CACHE_DIR`` env >
+the repo-local ``.jax_cache`` (shared with the driver's helper children
+via ``hostenv``).
+
+Donation policy: buffer donation SEGFAULTS on executables deserialized
+from the persistent compilation cache on jaxlib<=0.4.36. That guard
+used to live inline in ``obs/xla.instrumented_jit``; it is now the
+version-gated ``donation_allowed()`` here, shared by every program
+boundary that donates — newer jaxlibs keep donation even with the
+cache armed, affected ones drop it (donation is a memory optimisation
+only), and ``LGBM_TPU_NO_DONATE`` force-drops regardless.
+
+Hygiene: the cache directory grows without bound on a long-lived host
+(every shape bucket of every model adds entries). ``prune_cache()`` is
+a best-effort LRU prune to the ``LGBM_TPU_COMPILE_CACHE_MAX_BYTES``
+budget (default 4 GiB; <=0 disables), run at most once per directory
+per process, and ONLY for directories this framework owns (our knob /
+``LGBM_TPU_COMPILE_CACHE_DIR`` / the repo-local default) — an
+inherited ``JAX_COMPILATION_CACHE_DIR`` may be shared with other
+projects and is never deleted from. A pruned entry is only a future
+cache miss — XLA regenerates it — so pruning can never break a
+running process.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# first jaxlib where donating into an executable deserialized from the
+# persistent compilation cache no longer segfaults (the 0.4.36 crash —
+# see obs/xla.py history and the tier-1 conftest notes)
+DONATION_SAFE_JAXLIB = (0, 4, 37)
+
+_DEFAULT_MAX_BYTES = 4 << 30
+
+# modes this module accepts for tpu_compile_cache
+_MODES = ("auto", "on", "off")
+
+
+def repo_cache_dir() -> str:
+    """The repo-local ``.jax_cache`` shared with hostenv's children."""
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        ".jax_cache")
+
+
+def default_cache_dir() -> str:
+    """Cache directory resolution (env overrides > repo-local)."""
+    return (os.environ.get("LGBM_TPU_COMPILE_CACHE_DIR")
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR")
+            or repo_cache_dir())
+
+
+def cache_active() -> bool:
+    """True when a persistent compilation cache is configured — via
+    ``jax.config`` (which also absorbs ``JAX_COMPILATION_CACHE_DIR``)
+    or, before jax is importable, the env var alone."""
+    try:
+        import jax
+        return bool(jax.config.jax_compilation_cache_dir)
+    except Exception:
+        return bool(os.environ.get("JAX_COMPILATION_CACHE_DIR"))
+
+
+def _jaxlib_version() -> tuple:
+    try:
+        import jaxlib
+        return tuple(int(p) for p in
+                     str(jaxlib.__version__).split(".")[:3])
+    except Exception:
+        return (0, 0, 0)
+
+
+def donation_allowed() -> bool:
+    """THE donation policy for every program boundary (obs/xla's
+    ``instrumented_jit`` consults this before passing donate_argnums):
+    donation is dropped when ``LGBM_TPU_NO_DONATE`` is set, or when the
+    persistent cache is armed on a jaxlib where donating into a
+    cache-deserialized executable segfaults (<= 0.4.36)."""
+    if os.environ.get("LGBM_TPU_NO_DONATE"):
+        return False
+    if not cache_active():
+        return True
+    return _jaxlib_version() >= DONATION_SAFE_JAXLIB
+
+
+def configure(mode: str = "auto", cache_dir: Optional[str] = None) -> bool:
+    """Arm the persistent compilation cache per the module docstring.
+
+    Returns True when a cache is active after the call (whether this
+    call armed it or an earlier configuration did). Best-effort: any
+    jax config failure (too-old jax, read-only filesystem) returns
+    False rather than raising — cold compiles are slow, not wrong.
+    """
+    mode = str(mode or "auto").lower()
+    if mode not in _MODES:
+        from . import log
+        log.warning(f"tpu_compile_cache={mode!r} is not one of {_MODES}; "
+                    "treating as 'auto'")
+        mode = "auto"
+    if mode == "off":
+        return False
+    if mode == "auto" and cache_active():
+        return True
+    path = cache_dir or default_cache_dir()
+    # only ever prune a directory THIS framework owns: one named by our
+    # knob/env or the repo-local default. A user-managed
+    # JAX_COMPILATION_CACHE_DIR (possibly shared across projects) is
+    # used as-is but never deleted from.
+    owned = (cache_dir is not None
+             or bool(os.environ.get("LGBM_TPU_COMPILE_CACHE_DIR"))
+             or path == repo_cache_dir())
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir", path)
+        # cache everything, however small/fast: warm start must make
+        # compile_s_total ~0, and a skipped tiny program would still
+        # recompile every process (hostenv learned this the hard way
+        # with driver-timeout rounds 3+4)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        return False
+    if owned:
+        prune_cache_once(path)
+    return True
+
+
+_pruned_once: set = set()  # dirs already pruned in this process
+
+
+def prune_cache_once(cache_dir: str) -> int:
+    """``prune_cache``, at most once per directory per process — the
+    hygiene pass costs a full os.walk/stat sweep, which must not repeat
+    for every Booster a sweep or cv() constructs."""
+    if cache_dir in _pruned_once:
+        return 0
+    _pruned_once.add(cache_dir)
+    return prune_cache(cache_dir)
+
+
+def cache_size_bytes(cache_dir: Optional[str] = None) -> int:
+    """Total bytes under the cache directory (0 when absent)."""
+    root = cache_dir or default_cache_dir()
+    total = 0
+    for dirpath, _dirnames, filenames in os.walk(root):
+        for name in filenames:
+            try:
+                total += os.stat(os.path.join(dirpath, name)).st_size
+            except OSError:
+                continue
+    return total
+
+
+def prune_cache(cache_dir: Optional[str] = None,
+                max_bytes: Optional[int] = None) -> int:
+    """Best-effort LRU prune of the cache directory to `max_bytes`
+    (default ``LGBM_TPU_COMPILE_CACHE_MAX_BYTES``, 4 GiB; <=0 =
+    unbounded). Oldest entries — by last access where the filesystem
+    tracks it, else last modification — go first. Returns the bytes
+    removed. Never raises: a prune failure only means a bigger cache."""
+    if max_bytes is None:
+        try:
+            max_bytes = int(os.environ.get(
+                "LGBM_TPU_COMPILE_CACHE_MAX_BYTES", _DEFAULT_MAX_BYTES))
+        except ValueError:
+            max_bytes = _DEFAULT_MAX_BYTES
+    if max_bytes <= 0:
+        return 0
+    root = cache_dir or default_cache_dir()
+    entries = []  # (lru_stamp, size, path)
+    total = 0
+    try:
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for name in filenames:
+                path = os.path.join(dirpath, name)
+                try:
+                    st = os.stat(path)
+                except OSError:
+                    continue
+                entries.append((max(st.st_atime, st.st_mtime),
+                                st.st_size, path))
+                total += st.st_size
+    except OSError:
+        return 0
+    if total <= max_bytes:
+        return 0
+    removed = 0
+    entries.sort()  # oldest first
+    for _stamp, size, path in entries:
+        if total - removed <= max_bytes:
+            break
+        try:
+            os.unlink(path)
+        except OSError:
+            continue
+        removed += size
+    return removed

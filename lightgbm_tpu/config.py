@@ -185,6 +185,8 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_preflight": ["preflight", "memory_preflight"],
     "tpu_health": ["health", "training_health"],
     "tpu_health_every": ["health_every", "health_check_every"],
+    "tpu_compile_cache": ["compile_cache", "persistent_compile_cache"],
+    "tpu_compile_cache_dir": ["compile_cache_dir"],
     # resilience knobs (resilience/ subsystem)
     "tpu_checkpoint_every": ["checkpoint_every", "checkpoint_freq"],
     "tpu_checkpoint_path": ["checkpoint_path", "checkpoint_file"],
@@ -206,6 +208,7 @@ _ALIASES: Dict[str, List[str]] = {
     "serve_retry_backoff_ms": [],
     "serve_breaker_threshold": ["serve_breaker_failures"],
     "serve_breaker_reset_s": ["serve_breaker_reset"],
+    "serve_artifact_dir": ["artifact_dir", "serve_artifacts_dir"],
 }
 
 _ALIAS_TO_CANONICAL: Dict[str, str] = {}
@@ -606,6 +609,24 @@ class Config:
     # straggler probe): every N iterations. 1 = every iteration; larger
     # values amortize the tiny host sync the sentinel read costs.
     tpu_health_every: int = 1
+    # persistent XLA compile cache (compile_cache.py; ROADMAP item 2 —
+    # kill cold start). "auto" (default) arms
+    # jax.config.jax_compilation_cache_dir at the train/serve entry
+    # UNLESS something already configured one (an existing jax.config
+    # setting or JAX_COMPILATION_CACHE_DIR env wins); "on" forces it to
+    # tpu_compile_cache_dir (falling back to LGBM_TPU_COMPILE_CACHE_DIR
+    # env, then the repo-local .jax_cache); "off" opts this entry point
+    # out without disarming anything. A cache-warm second process pays
+    # ~zero compile seconds for the same programs (bench.py --coldstart
+    # measures it; perf-gate check 10 caps it). Donation caveat: with
+    # the cache armed on jaxlib<=0.4.36, buffer donation is dropped at
+    # every program boundary (compile_cache.donation_allowed) — donating
+    # into a cache-deserialized executable segfaults there; set "off"
+    # to keep donation (peak-HBM) instead on those jaxlibs. Framework-
+    # owned cache dirs are LRU-pruned once per process to
+    # LGBM_TPU_COMPILE_CACHE_MAX_BYTES (default 4 GiB).
+    tpu_compile_cache: str = "auto"
+    tpu_compile_cache_dir: str = ""
     # fault-tolerant training (resilience/checkpoint.py). With
     # tpu_checkpoint_path set, engine.train snapshots FULL boosting
     # state (trees + scores + sampling masks + RNG streams + DART drop
@@ -686,6 +707,18 @@ class Config:
     serve_retry_backoff_ms: float = 10.0
     serve_breaker_threshold: int = 5
     serve_breaker_reset_s: float = 30.0
+    # serialized AOT serving artifacts (serve/artifacts.py): when set,
+    # every low-latency executable a model compiles is exported to this
+    # directory (jax.experimental.serialize_executable), keyed by an
+    # artifact fingerprint (format version + jax/jaxlib + backend +
+    # packed-ensemble digest + bucket/width), and ModelServer.warm() /
+    # LRU re-admission re-import instead of recompiling — a replica
+    # restart warms from disk in milliseconds with ZERO
+    # serve/lowlat compiles (obs-counter-asserted by
+    # tools/check_coldstart.py). Any fingerprint mismatch falls back to
+    # a fresh compile with bit-identical predictions either way.
+    # Empty = off.
+    serve_artifact_dir: str = ""
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)

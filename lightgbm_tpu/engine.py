@@ -27,6 +27,12 @@ def train(params: Dict[str, Any], train_set: Dataset,
     """(ref: engine.py:109)"""
     params = dict(params or {})
     cfg = Config.from_params(params)
+    # persistent compile cache at the train entry (compile_cache.py):
+    # Booster.__init__ arms it too, but the explicit entry-point call
+    # keeps the warm-start contract visible where ISSUE 14 pinned it
+    from .compile_cache import configure as _configure_compile_cache
+    _configure_compile_cache(cfg.tpu_compile_cache,
+                             cfg.tpu_compile_cache_dir or None)
     if cfg.num_iterations != 100 and "num_boost_round" not in params:
         num_boost_round = cfg.num_iterations
     if cfg.early_stopping_round and cfg.early_stopping_round > 0:
@@ -380,6 +386,9 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     if metrics is not None:
         params["metric"] = metrics
     cfg = Config.from_params(params)
+    from .compile_cache import configure as _configure_compile_cache
+    _configure_compile_cache(cfg.tpu_compile_cache,
+                             cfg.tpu_compile_cache_dir or None)
     if cfg.num_iterations != 100 and "num_boost_round" not in params:
         num_boost_round = cfg.num_iterations
     if cfg.objective in ("binary", "multiclass", "multiclassova") \

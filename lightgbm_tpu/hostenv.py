@@ -61,13 +61,25 @@ def cpu_child_env(n_devices: Optional[int] = None,
     # warm cache every invocation recompiles from scratch and can blow the
     # driver's timeout (rounds 3+4: rc=124). Cache everything, however
     # small/fast, so a warmed program is a disk hit for the driver.
+    # (In-process entries — train/serve — arm the same cache through
+    # compile_cache.configure; this env path is only for children.)
     env.setdefault("JAX_COMPILATION_CACHE_DIR", _repo_cache_dir())
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     env.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    # best-effort LRU hygiene before handing the dir to another process:
+    # the repo-local cache grows without bound on a long-lived host.
+    # ONLY the repo-local default is pruned — an inherited
+    # JAX_COMPILATION_CACHE_DIR is a user-managed directory this
+    # library must never delete from.
+    try:
+        from .compile_cache import prune_cache_once, repo_cache_dir
+        if env["JAX_COMPILATION_CACHE_DIR"] == repo_cache_dir():
+            prune_cache_once(env["JAX_COMPILATION_CACHE_DIR"])
+    except Exception:
+        pass
     return env
 
 
 def _repo_cache_dir() -> str:
-    return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        ".jax_cache")
+    from .compile_cache import repo_cache_dir
+    return repo_cache_dir()

@@ -263,6 +263,16 @@ def render_openmetrics(registry=None,
     doc.sample("lgbmtpu_xla_compile_seconds_total", "counter",
                xs["compile_s_total"],
                help_text="wall time spent compiling XLA programs")
+    doc.sample("lgbmtpu_xla_trace_seconds_total", "counter",
+               xs.get("trace_s_total", 0.0),
+               help_text="wall time spent tracing/lowering before "
+                         "compile (no cache can skip it)")
+    doc.sample("lgbmtpu_xla_cache_load_seconds_total", "counter",
+               xs.get("cache_load_s_total", 0.0),
+               help_text="wall time loading programs from the "
+                         "persistent compilation cache")
+    doc.sample("lgbmtpu_xla_cache_hits_total", "counter",
+               xs.get("n_cache_hits", 0))
     doc.sample("lgbmtpu_xla_programs_total", "counter", xs["n_programs"])
     for phase in sorted(xs["n_recompiles_by_phase"]):
         doc.sample("lgbmtpu_xla_compiles_total", "counter",

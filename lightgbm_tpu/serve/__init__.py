@@ -13,18 +13,24 @@ small concurrent requests. This package turns one into the other:
   per row and the per-row f32 accumulation order never changes).
 - ``lowlat``    — the dedicated B<=64 path: per-model AOT-compiled
   traversal executables that bypass the batch machinery entirely.
+- ``artifacts`` — serialized AOT executables on disk: a replica
+  restart or an LRU re-admission warms the lowlat ladder from the
+  artifact store in milliseconds instead of recompiling (fingerprint-
+  keyed; any mismatch falls back to a fresh, bit-identical compile).
 - ``server``    — the asyncio front that routes requests by size,
   tracks per-request latency into ``obs.metrics`` p50/p95/p99
   reservoirs, and backs ``python -m lightgbm_tpu serve`` and
   ``bench.py --serve``.
 """
 
+from .artifacts import ArtifactStore, serialize_available  # noqa: F401
 from .registry import ModelRegistry, ServedModel  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
 from .lowlat import SERVE_LOWLAT_TAG, LowLatencyPredictor  # noqa: F401
 from .server import ModelServer, replay, serve_file  # noqa: F401
 
 __all__ = [
+    "ArtifactStore", "serialize_available",
     "ModelRegistry", "ServedModel", "MicroBatcher",
     "LowLatencyPredictor", "SERVE_LOWLAT_TAG",
     "ModelServer", "replay", "serve_file",

@@ -33,10 +33,14 @@ from ..obs.metrics import global_metrics
 from ..obs.xla import global_xla
 from ..ops.predict import (_ARRAY_FIELDS, PackedEnsemble, _next_pow2,
                            pack_ensemble, predict_raw_multiclass)
+from .artifacts import backend_fingerprint, open_store, trees_digest
 
 # AOT warmup compiles are counted under this tag (the low-latency twin
 # of PREDICT_TRACE_TAG); steady-state stability is asserted through
-# global_metrics.recompiles(SERVE_LOWLAT_TAG)
+# global_metrics.recompiles(SERVE_LOWLAT_TAG). Artifact restores count
+# serve/aot_loads INSTEAD — a loaded executable never traces, so the
+# recompile counter staying flat is the proof a restore really skipped
+# the compiler.
 SERVE_LOWLAT_TAG = "serve/lowlat"
 
 
@@ -50,7 +54,8 @@ class LowLatencyPredictor:
     """
 
     def __init__(self, trees: List, num_tree_per_iteration: int = 1,
-                 max_rows: int = 64, average_output: bool = False):
+                 max_rows: int = 64, average_output: bool = False,
+                 artifact_dir: str = ""):
         self._trees = trees
         self._k = max(int(num_tree_per_iteration), 1)
         self.max_rows = max(int(max_rows), 1)
@@ -59,6 +64,12 @@ class LowLatencyPredictor:
         self._ens: PackedEnsemble = None
         self._arrs: Tuple[jax.Array, ...] = ()
         self._compiled: Dict[Tuple[int, int], object] = {}
+        # serialized-artifact store (serve/artifacts.py): compiled
+        # executables write through to disk and later instances (replica
+        # restart, LRU re-admission) load instead of recompiling. None
+        # when no dir is configured or jax can't serialize.
+        self._store = open_store(artifact_dir)
+        self._fingerprint = None  # model-identity half of artifact keys
 
     # ------------------------------------------------------------------
     def _ensure_packed(self) -> None:
@@ -84,44 +95,143 @@ class LowLatencyPredictor:
     def bucket(self, rows: int) -> int:
         return min(_next_pow2(rows), self.max_rows) if rows else 1
 
+    def _artifact_key(self, rows_bucket: int, num_features: int) -> dict:
+        """Full artifact fingerprint for one (bucket, width) program:
+        runtime identity + packed-tensor layout names ("pack version")
+        + packed shapes/dtypes + the host trees' content digest + the
+        program shape itself. Everything is host-known — key
+        construction never reads device memory back."""
+        if self._fingerprint is None:
+            fp = backend_fingerprint()
+            fp["pack_fields"] = list(_ARRAY_FIELDS)
+            fp["pack_shapes"] = [[list(a.shape), str(a.dtype)]
+                                 for a in self._arrs]
+            fp["model_digest"] = trees_digest(self._trees, self._k)
+            fp["k"] = self._k
+            self._fingerprint = fp
+        return dict(self._fingerprint, bucket=int(rows_bucket),
+                    width=int(num_features))
+
+    def _compile_for_store(self, lowered):
+        """``lowered.compile()``, bypassing the persistent XLA compile
+        cache when an artifact store will serialize the result: on
+        affected jaxlibs an executable that was itself DESERIALIZED
+        from the disk cache re-serializes incompletely ("Symbols not
+        found" on a later load), so an exportable executable must come
+        from a fresh backend compile. The artifact store IS this
+        ladder's persistent cache, so the bypass costs one fresh
+        compile exactly where a serialized artifact replaces the disk
+        cache anyway. No store => plain (cache-served) compile.
+
+        Mechanics: clearing the cache dir alone is NOT enough — jax
+        memoizes its "cache in use" verdict process-wide
+        (compilation_cache._cache_checked), so the verdict is reset
+        around the un-cached compile and again after the dir is
+        restored (the next ordinary compile then re-initializes the
+        cache lazily). Internal-API use is fully guarded: if it drifts,
+        we fall back to the cache-served compile and rely on the
+        store's save-time validation to refuse a bad artifact."""
+        if self._store is None:
+            return lowered.compile()
+        import jax as _jax
+        try:
+            from jax._src import compilation_cache as _cc
+            prev = _jax.config.jax_compilation_cache_dir
+            if prev is None:
+                return lowered.compile()
+            _cc.reset_cache()
+            _jax.config.update("jax_compilation_cache_dir", None)
+        except Exception:
+            return lowered.compile()
+        try:
+            return lowered.compile()
+        finally:
+            try:
+                _jax.config.update("jax_compilation_cache_dir", prev)
+                _cc.reset_cache()
+            except Exception:
+                pass
+
     def _program(self, rows_bucket: int, num_features: int):
         key = (rows_bucket, num_features)
         prog = self._compiled.get(key)
-        if prog is None:
-            ens = self._ens
+        if prog is not None:
+            # idempotent per (bucket, width): a resident executable is
+            # NEVER rebuilt — warm() re-runs, repeated requests, and
+            # overlapping widths all land here
+            return prog
+        if self._store is not None:
+            prog = self._store.load(self._artifact_key(rows_bucket,
+                                                       num_features))
+            if prog is not None:
+                # restored from disk: no trace, no compile — the
+                # SERVE_LOWLAT_TAG recompile counter stays flat and
+                # serve/aot_loads (counted by the store) ticks instead
+                self._compiled[key] = prog
+                return prog
+        ens = self._ens
 
-            def run(*args):
-                e = PackedEnsemble(
-                    *args[:-1], max_depth=ens.max_depth,
-                    num_trees_per_class=ens.num_trees_per_class,
-                    num_trees=ens.num_trees,
-                    has_categorical=ens.has_categorical)
-                return predict_raw_multiclass(e, args[-1])
+        def run(*args):
+            e = PackedEnsemble(
+                *args[:-1], max_depth=ens.max_depth,
+                num_trees_per_class=ens.num_trees_per_class,
+                num_trees=ens.num_trees,
+                has_categorical=ens.has_categorical)
+            return predict_raw_multiclass(e, args[-1])
 
-            shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype)
-                      for a in self._arrs]
-            shapes.append(jax.ShapeDtypeStruct(
-                (rows_bucket, num_features), jnp.float32))
-            t0 = time.perf_counter()
-            prog = jax.jit(global_metrics.wrap_traced(SERVE_LOWLAT_TAG, run)
-                           ).lower(*shapes).compile()
-            if global_xla.enabled:
-                # this path IS the lower/compile boundary — record the
-                # executable's cost facts straight into the introspector
-                global_xla.note_compile(
-                    SERVE_LOWLAT_TAG, "serve",
-                    f"{rows_bucket}x{num_features}",
-                    time.perf_counter() - t0, prog)
-            self._compiled[key] = prog
+        shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                  for a in self._arrs]
+        shapes.append(jax.ShapeDtypeStruct(
+            (rows_bucket, num_features), jnp.float32))
+        t0 = time.perf_counter()
+        lowered = jax.jit(global_metrics.wrap_traced(SERVE_LOWLAT_TAG, run)
+                          ).lower(*shapes)
+        t1 = time.perf_counter()
+        hits0 = global_xla.cache_hits() if global_xla.enabled else 0
+        prog = self._compile_for_store(lowered)
+        if global_xla.enabled:
+            # this path IS the lower/compile boundary — record the
+            # executable's cost facts straight into the introspector
+            global_xla.note_compile(
+                SERVE_LOWLAT_TAG, "serve",
+                f"{rows_bucket}x{num_features}",
+                time.perf_counter() - t1, prog, trace_s=t1 - t0,
+                cache_hit=global_xla.cache_hits() > hits0)
+        self._compiled[key] = prog
+        if self._store is not None:
+            # write-through: the NEXT predictor instance (restart,
+            # re-admission) warms from disk instead of this code path
+            self._store.save(self._artifact_key(rows_bucket,
+                                                num_features), prog)
         return prog
 
     def warm(self, num_features: int) -> int:
-        """Precompile every bucket for `num_features`-wide requests;
-        returns the number of executables now resident."""
+        """Make every bucket for `num_features`-wide requests resident —
+        loading serialized artifacts where the store has them, compiling
+        (and exporting) the rest; returns the number of executables now
+        resident. Idempotent: re-warming an already-resident ladder
+        compiles nothing."""
         self._ensure_packed()
         for b in self.buckets():
             self._program(b, num_features)
         return len(self._compiled)
+
+    def export_artifacts(self, num_features: int) -> int:
+        """Warm the full ladder AND ensure every executable is on disk
+        (the explicit export entry for a build/deploy step; write-
+        through already covers the incremental case). Returns the
+        number of artifacts present for this ladder. 0 when no artifact
+        store is configured."""
+        if self._store is None:
+            return 0
+        self.warm(num_features)
+        n = 0
+        for b in self.buckets():
+            akey = self._artifact_key(b, num_features)
+            if self._store.has(akey) or \
+                    self._store.save(akey, self._compiled[(b, num_features)]):
+                n += 1
+        return n
 
     # ------------------------------------------------------------------
     def __call__(self, data: np.ndarray) -> np.ndarray:

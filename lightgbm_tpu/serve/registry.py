@@ -7,8 +7,10 @@ their device mirrors) and the AOT low-latency executables. The registry
 therefore evicts PACKS, not models: over the ``max_pack_bytes`` budget
 the least-recently-used model's packed tensors and compiled small-batch
 programs are dropped, while the host model stays loaded. The next
-request against an evicted model transparently re-packs (and pays the
-warmup compiles again) and — because packing is deterministic and the
+request against an evicted model transparently re-packs (re-importing
+its low-latency executables from the serialized artifact store when an
+``artifact_dir`` is configured — serve/artifacts.py — instead of paying
+the warmup compiles again) and — because packing is deterministic and the
 ``(tree, pack_version)`` identity tokens are revalidated on every
 ``EnsemblePacker.update`` — produces bit-identical predictions
 (asserted by tests/test_serve.py).
@@ -35,10 +37,16 @@ class ServedModel:
     """One registry entry: a loaded model plus its serving state (the
     lazily-built low-latency predictor). Create via ModelRegistry.load."""
 
-    def __init__(self, name: str, model, lowlat_max_rows: int = 64):
+    def __init__(self, name: str, model, lowlat_max_rows: int = 64,
+                 artifact_dir: str = ""):
         self.name = name
         self.model = model  # model_io.LoadedModel
         self.lowlat_max_rows = int(lowlat_max_rows)
+        # serialized-AOT artifact directory (serve/artifacts.py): the
+        # low-latency predictor writes its compiled executables through
+        # to disk and re-creation (LRU re-admission, replica restart)
+        # loads them back instead of recompiling
+        self.artifact_dir = str(artifact_dir or "")
         self._lowlat: Optional[LowLatencyPredictor] = None
         # linear-tree leaves predict on host (the engine has no linear
         # path) — such models always route through predict_raw
@@ -79,7 +87,8 @@ class ServedModel:
                 self.model.trees,
                 num_tree_per_iteration=self.model.num_tree_per_iteration,
                 max_rows=self.lowlat_max_rows,
-                average_output=self.model.average_output)
+                average_output=self.model.average_output,
+                artifact_dir=self.artifact_dir)
         return self._lowlat
 
     # -- pack accounting / eviction ------------------------------------
@@ -117,13 +126,24 @@ class ModelRegistry:
 
     def __init__(self, max_pack_bytes: int = 1 << 30,
                  lowlat_max_rows: int = 64,
-                 predict_chunk_rows: int = 1 << 20):
+                 predict_chunk_rows: int = 1 << 20,
+                 artifact_dir: str = "",
+                 compile_cache: str = "auto"):
         self.max_pack_bytes = int(max_pack_bytes)
         self.lowlat_max_rows = int(lowlat_max_rows)
         # serving chunk size (tpu_predict_chunk) — what the memory
         # preflight sizes the per-dispatch working set with
         self.predict_chunk_rows = int(predict_chunk_rows)
+        # serialized-AOT artifacts for every model this registry serves
+        # (serve_artifact_dir knob; "" = off)
+        self.artifact_dir = str(artifact_dir or "")
         self._entries: "OrderedDict[str, ServedModel]" = OrderedDict()
+        # the serve-side program boundary arms the persistent compile
+        # cache too (tpu_compile_cache policy — the engine shape buckets
+        # warmed through predict_raw ride the XLA disk cache, the
+        # lowlat ladder rides the artifact store)
+        from ..compile_cache import configure as _configure_compile_cache
+        _configure_compile_cache(compile_cache)
 
     # ------------------------------------------------------------------
     def load(self, name: str, model=None, model_file: Optional[str] = None,
@@ -155,7 +175,8 @@ class ModelRegistry:
             model = load_model_from_string(model_str)
         elif booster is not None:
             model = load_model_from_string(booster.model_to_string())
-        entry = ServedModel(name, model, self.lowlat_max_rows)
+        entry = ServedModel(name, model, self.lowlat_max_rows,
+                            artifact_dir=self.artifact_dir)
         if faults_mod.global_faults.armed:
             faults_mod.global_faults.check_registry_load(name)
         if validate and model.trees:

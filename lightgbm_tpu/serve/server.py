@@ -444,7 +444,9 @@ def serve_file(input_model: str, data_path: str, output_result: str,
                                                 dict(params or {}))
     registry = ModelRegistry(max_pack_bytes=cfg.serve_cache_bytes,
                              lowlat_max_rows=cfg.serve_lowlat_max_rows,
-                             predict_chunk_rows=cfg.tpu_predict_chunk)
+                             predict_chunk_rows=cfg.tpu_predict_chunk,
+                             artifact_dir=cfg.serve_artifact_dir,
+                             compile_cache=cfg.tpu_compile_cache)
     # validate=True: prove the model can pack + predict BEFORE the
     # server starts taking traffic on it (serving startup, not a
     # hot-swap — the upfront smoke is free relative to warm())

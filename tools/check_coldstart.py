@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Validator for warm start (ISSUE 14: persistent compile cache +
+serialized AOT serving artifacts).
+
+Drives the REAL code paths end-to-end — the acceptance scenario of the
+cold-start PR, kept honest in CI:
+
+1. **Second-process warm start** — the same small train run in two
+   fresh interpreter processes sharing one fresh compile-cache dir:
+   the cold run pays real XLA compiles, the warm rerun's
+   ``compile_s_total`` (obs/xla, with persistent-cache hits attributed
+   to ``cache_load_s_total`` instead) must be >= 5x smaller, and the
+   warm run must actually HIT the cache (``n_cache_hits`` > 0).
+2. **Artifact-restore serve smoke** — a model's low-latency ladder is
+   exported to a serialized-artifact store, then a fresh registry +
+   ``ModelServer`` (the replica-restart twin) warms from disk: ZERO
+   ``serve/lowlat`` compiles (obs recompile counters), every program
+   an ``serve/aot_loads``, first request + steady-state traffic with
+   zero further recompiles, predictions bit-identical to the
+   exporter's.
+3. **Fingerprint mismatch falls back** — with the stored artifacts
+   re-keyed under a foreign fingerprint, the same restore transparently
+   RECOMPILES (counted) and still predicts bit-identically: artifacts
+   are an accelerator, never a correctness dependency.
+
+Graceful skip (exit 0 with a notice) where
+``jax.experimental.serialize_executable`` is unavailable — step 1 still
+runs; the cache needs no serialization support.
+
+Exit 0 = all steps passed. Wired into the quick verification tier via
+tests/test_coldstart.py (TestToolsWiring).
+"""
+
+import asyncio
+import os
+import shutil
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+_F = 8
+
+
+def _model_str() -> str:
+    import lightgbm_tpu as lgb
+    r = np.random.RandomState(7)
+    X = r.randn(600, _F)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 > 0.3).astype(np.float32)
+    params = dict(objective="binary", num_leaves=15, learning_rate=0.1,
+                  max_bin=63, min_data_in_leaf=5, verbosity=-1)
+    ds = lgb.Dataset(X, label=y, params=params)
+    ds.construct()
+    return lgb.train(params, ds, num_boost_round=4).model_to_string()
+
+
+def step1_second_process_warm_start() -> None:
+    import bench
+    cache_dir = tempfile.mkdtemp(prefix="check_cs_cache_")
+    try:
+        os.environ["COLDSTART_ITERS"] = "2"
+        os.environ["COLDSTART_LEAVES"] = "31"
+        cold = bench._coldstart_child_run(cache_dir, 8000)
+        warm = bench._coldstart_child_run(cache_dir, 8000)
+    finally:
+        os.environ.pop("COLDSTART_ITERS", None)
+        os.environ.pop("COLDSTART_LEAVES", None)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+    assert cold["compile_s_total"] > 0, cold
+    assert warm.get("n_cache_hits", 0) > 0, \
+        f"warm rerun never hit the persistent cache: {warm}"
+    reduction = cold["compile_s_total"] / max(warm["compile_s_total"], 1e-2)
+    assert reduction >= 5.0, \
+        (f"warm compile {warm['compile_s_total']:.3f}s vs cold "
+         f"{cold['compile_s_total']:.3f}s — only {reduction:.2f}x")
+    print(f"# step 1 OK: cold compile {cold['compile_s_total']:.2f}s -> "
+          f"warm {warm['compile_s_total']:.2f}s ({reduction:.1f}x; "
+          f"{warm.get('n_cache_hits', 0)} cache hit(s), "
+          f"{warm.get('cache_load_s_total', 0.0):.2f}s loading)")
+
+
+def step2_artifact_restore() -> bool:
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.serve import (ModelRegistry, ModelServer,
+                                    SERVE_LOWLAT_TAG, serialize_available)
+    if not serialize_available():
+        print("# step 2 SKIPPED: jax.experimental.serialize_executable "
+              "unavailable on this backend")
+        return False
+    model_str = _model_str()
+    art_dir = tempfile.mkdtemp(prefix="check_cs_art_")
+    try:
+        req = np.random.RandomState(1).randn(5, _F)
+        reg_a = ModelRegistry(artifact_dir=art_dir)
+        entry_a = reg_a.load("m", model_str=model_str)
+        n_progs = entry_a.lowlat.warm(_F)
+        assert len(os.listdir(art_dir)) == n_progs, \
+            "every compiled executable must have exported an artifact"
+        ref = entry_a.lowlat(req)
+
+        # replica restart: fresh registry + server over the same store
+        reg_b = ModelRegistry(artifact_dir=art_dir)
+        reg_b.load("m", model_str=model_str)
+        server = ModelServer(reg_b)
+        c0 = global_metrics.recompiles(SERVE_LOWLAT_TAG)
+        loads0 = global_metrics.counters.get("serve/aot_loads", 0)
+
+        async def run():
+            outs = [await server.predict("m", req, raw_score=True)]
+            for rows in (1, 3, 5, 2, 4):  # steady-state mixed smalls
+                outs.append(await server.predict("m", req[:rows],
+                                                 raw_score=True))
+            await server.close()
+            return outs
+
+        outs = asyncio.run(run())
+        d_compiles = global_metrics.recompiles(SERVE_LOWLAT_TAG) - c0
+        d_loads = global_metrics.counters.get("serve/aot_loads",
+                                              0) - loads0
+        assert d_compiles == 0, \
+            (f"artifact-restored server paid {d_compiles} serve/lowlat "
+             "compile(s); the whole ladder must come from disk")
+        assert d_loads > 0, "restore never touched the artifact store"
+        assert np.array_equal(np.squeeze(ref), np.asarray(outs[0])), \
+            "restored predictions must be bit-identical"
+    finally:
+        shutil.rmtree(art_dir, ignore_errors=True)
+    print(f"# step 2 OK: restore served first request with 0 compiles "
+          f"({d_loads} artifact load(s)), steady state clean, "
+          "bit-identical")
+    return True
+
+
+def step3_fingerprint_mismatch() -> None:
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.serve import (ModelRegistry, SERVE_LOWLAT_TAG,
+                                    serialize_available)
+    from lightgbm_tpu.serve import artifacts as artifacts_mod
+    if not serialize_available():
+        print("# step 3 SKIPPED: no executable serialization")
+        return
+    model_str = _model_str()
+    art_dir = tempfile.mkdtemp(prefix="check_cs_mismatch_")
+    try:
+        req = np.random.RandomState(2).randn(4, _F)
+        reg_a = ModelRegistry(artifact_dir=art_dir)
+        entry_a = reg_a.load("m", model_str=model_str)
+        entry_a.lowlat.warm(_F)
+        ref = entry_a.lowlat(req)
+
+        # a "new jaxlib" replica: every stored fingerprint now foreign
+        orig = artifacts_mod.ARTIFACT_VERSION
+        artifacts_mod.ARTIFACT_VERSION = orig + 1
+        try:
+            reg_b = ModelRegistry(artifact_dir=art_dir)
+            entry_b = reg_b.load("m", model_str=model_str)
+            c0 = global_metrics.recompiles(SERVE_LOWLAT_TAG)
+            entry_b.lowlat.warm(_F)
+            d = global_metrics.recompiles(SERVE_LOWLAT_TAG) - c0
+            assert d > 0, "mismatched fingerprints must recompile"
+            out = entry_b.lowlat(req)
+            assert np.array_equal(ref, out), \
+                "fallback recompile must stay bit-identical"
+        finally:
+            artifacts_mod.ARTIFACT_VERSION = orig
+    finally:
+        shutil.rmtree(art_dir, ignore_errors=True)
+    print(f"# step 3 OK: foreign fingerprint fell back to {d} "
+          "recompile(s), bit-identical either way")
+
+
+def main() -> int:
+    step1_second_process_warm_start()
+    ran2 = step2_artifact_restore()
+    step3_fingerprint_mismatch()
+    n = 3 if ran2 else 1
+    print(f"# coldstart validator OK ({n}/3 steps ran; skips are "
+          "capability-gated)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -582,6 +582,83 @@ def check_stream_overhead(floor, failures, candidate_path=None):
               f"(ceilings {max_overhead:.2f}x / >={min_overlap:.0%})")
 
 
+def check_coldstart(floor, failures, candidate_path=None):
+    """Warm-start ceilings (check 10): over the latest bench record
+    carrying a `coldstart` summary (bench.py --coldstart):
+
+    - the cache-warm rerun's REAL compile seconds must be at least
+      ``min_compile_reduction`` x smaller than the cold run's (warm
+      processes load, they don't compile — obs/xla attributes
+      persistent-cache hits to cache_load_s, not compile_s);
+    - total warm-start program-acquisition time (compile + cache load)
+      must stay under the RATCHETING ``max_warm_acquire_s`` ceiling —
+      lower it as cold start keeps shrinking;
+    - a server restored from serialized artifacts must have served its
+      first lowlat request with at most
+      ``max_restore_lowlat_compiles`` serve/lowlat compiles (0: the
+      whole ladder came from disk) — skipped, not failed, where the
+      backend cannot serialize executables at all;
+    - the restored executables' predictions must be bit-identical.
+
+    No coldstart bench recorded => the check reports itself skipped."""
+    cfg = floor.get("coldstart")
+    if not cfg:
+        print("# no coldstart floor recorded; coldstart check skipped")
+        return
+    recs = _load_keyed_records("coldstart", candidate_path)
+    if not recs:
+        print("# no coldstart bench recorded; coldstart check skipped")
+        return
+    tag, rec = recs[-1]
+    cs = rec["coldstart"]
+    cold = float(cs.get("cold_compile_s", 0.0))
+    warm = float(cs.get("warm_compile_s", 0.0))
+    if cold <= 0.0:
+        print(f"# coldstart[{tag}]: no cold compile recorded; "
+              "coldstart check skipped")
+        return
+    min_red = float(cfg.get("min_compile_reduction", 5.0))
+    max_acquire = float(cfg.get("max_warm_acquire_s", 5.0))
+    reduction = cold / max(warm, 1e-2)
+    acquire = warm + float(cs.get("warm_cache_load_s", 0.0))
+    if reduction < min_red:
+        failures.append(
+            f"{tag}: warm-start compile {warm:.3f}s is only "
+            f"{reduction:.2f}x below the cold run's {cold:.3f}s "
+            f"(floor {min_red:.1f}x) — the persistent compile cache "
+            "is not biting")
+    if acquire > max_acquire:
+        failures.append(
+            f"{tag}: warm-start program acquisition "
+            f"(compile {warm:.3f}s + cache load "
+            f"{cs.get('warm_cache_load_s', 0.0):.3f}s) exceeds the "
+            f"{max_acquire:.1f}s ratchet ceiling")
+    restore_ok = True
+    if not cs.get("artifact_serialize_available", True):
+        print(f"# coldstart[{tag}]: backend cannot serialize "
+              "executables; artifact-restore sub-check skipped")
+    else:
+        max_restore = int(cfg.get("max_restore_lowlat_compiles", 0))
+        restore = int(cs.get("restore_lowlat_compiles", 0))
+        if restore > max_restore:
+            restore_ok = False
+            failures.append(
+                f"{tag}: artifact-restored server paid {restore} "
+                f"serve/lowlat compile(s) (ceiling {max_restore}) — "
+                "the serialized-artifact path is not restoring")
+        if cs.get("restore_bit_identical") is False:
+            restore_ok = False
+            failures.append(
+                f"{tag}: artifact-restored predictions are NOT "
+                "bit-identical to the exporter's")
+    if reduction >= min_red and acquire <= max_acquire and restore_ok:
+        print(f"# coldstart[{tag}]: compile {cold:.2f}s -> {warm:.2f}s "
+              f"({reduction:.1f}x, floor {min_red:.0f}x), acquisition "
+              f"{acquire:.2f}s (ceiling {max_acquire:.1f}s), restore "
+              f"{int(cs.get('restore_lowlat_compiles', 0))} compile(s) "
+              f"/ {int(cs.get('restore_aot_loads', 0))} load(s)")
+
+
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
@@ -639,6 +716,7 @@ def main(argv=None) -> int:
     check_resilience_overhead(floor, failures, lines)
     check_continual_overhead(floor, failures, candidate)
     check_stream_overhead(floor, failures, candidate)
+    check_coldstart(floor, failures, candidate)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")
