@@ -18,6 +18,7 @@ Reference order of operations preserved (gbdt.cpp:353-461):
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -30,6 +31,8 @@ from .learner import grow_tree, grow_tree_waved, replay_tree
 from .obs import health as obs_health
 from .obs import xla as obs_xla
 from .obs.export import global_flusher
+from .obs.flightrec import global_flightrec
+from .obs.profile import global_profile
 from .resilience import faults as faults_mod
 from .obs.metrics import global_metrics
 from .obs.trace import global_tracer
@@ -263,6 +266,20 @@ class GBDT:
         self._health_armed = mode != "off"
         self._health_every = max(int(config.tpu_health_every), 1)
         self._health_tick = 0
+
+        # device-time profiling window (obs/profile.py; tpu_profile
+        # knob; LGBM_TPU_PROFILE env overrides for driver-side arming)
+        pmode = str(os.environ.get("LGBM_TPU_PROFILE", "")
+                    or config.tpu_profile).lower()
+        if pmode in ("off", "0", "false", "none", ""):
+            pmode = "off"
+        elif pmode not in ("window", "bench"):
+            raise ValueError(
+                f"tpu_profile={config.tpu_profile!r} is not one of "
+                "off/window/bench")
+        self._profile_mode = pmode
+        self._profile_left = max(int(config.tpu_profile_window), 1)
+        self._profile_started = False
         self._health_vec = None           # device [3] nonfinite counts
         self._health_pending_record = None  # slow-path replicated record
 
@@ -1489,6 +1506,15 @@ class GBDT:
             # iteration lifecycle so skew probes see it from ANY entry
             # point (engine / capi / sklearn), not just engine.train
             faults_mod.global_faults.maybe_slow_iteration()
+        if global_flightrec.armed:
+            # black-box iteration marker (obs/flightrec.py): at the
+            # lifecycle so every entry point records it, and BEFORE the
+            # work so a crashing iteration is in the dump
+            global_flightrec.record("iteration", iteration=int(self.iter),
+                                    trees=len(self._device_records)
+                                    + len(self._host_models))
+        if self._profile_mode != "off":
+            self._profile_tick()
         if not global_metrics.enabled:
             if not self._health_armed:
                 return self._train_one_iter_impl(custom_grad, custom_hess)
@@ -1515,6 +1541,26 @@ class GBDT:
                     obs_health.global_health.enabled:
                 # telemetry-only runs still get the straggler probe
                 obs_health.global_health.straggler_probe()
+
+    def _profile_tick(self) -> None:
+        """tpu_profile window lifecycle (obs/profile.py), called at the
+        top of each iteration. "window": opens the capture at iteration
+        1 — the compile-heavy first iteration would drown the steady
+        state — and closes it after tpu_profile_window iterations
+        (micro-reruns + roofline happen at close). "bench": opens
+        immediately and stays open; the harness reads/stops it."""
+        if self._profile_mode == "bench":
+            if not global_profile.capturing:
+                global_profile.start_window(source="bench")
+            return
+        if not self._profile_started:
+            if self.iter >= 1:
+                self._profile_started = True
+                global_profile.start_window(source="window")
+        elif global_profile.capturing:
+            self._profile_left -= 1
+            if self._profile_left <= 0:
+                global_profile.stop_window()
 
     @staticmethod
     def _observe_safely(fn, *args) -> None:

@@ -187,6 +187,8 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_health_every": ["health_every", "health_check_every"],
     "tpu_compile_cache": ["compile_cache", "persistent_compile_cache"],
     "tpu_compile_cache_dir": ["compile_cache_dir"],
+    "tpu_profile": ["profile", "device_profile"],
+    "tpu_profile_window": ["profile_window", "profile_iters"],
     # resilience knobs (resilience/ subsystem)
     "tpu_checkpoint_every": ["checkpoint_every", "checkpoint_freq"],
     "tpu_checkpoint_path": ["checkpoint_path", "checkpoint_file"],
@@ -609,6 +611,24 @@ class Config:
     # straggler probe): every N iterations. 1 = every iteration; larger
     # values amortize the tiny host sync the sentinel read costs.
     tpu_health_every: int = 1
+    # device-time profiling window (obs/profile.py). "off" (default) =
+    # one attribute check per program dispatch. "window" opens a
+    # capture window at iteration 1 (after the compile-heavy first
+    # iteration) spanning tpu_profile_window iterations: with
+    # LGBM_TPU_PROFILE_DIR set the real jax.profiler trace is captured
+    # and parsed into per-program device-busy seconds; without it the
+    # profiler-free fallback re-times every instrumented dispatch with
+    # a block_until_ready sync plus AOT micro-reruns at window close —
+    # the same attribution pipeline, usable on CPU CI. "bench" keeps
+    # the window open for the whole run (bench.py arms this itself
+    # around its measured loop). Capture only adds syncs — trained
+    # model bytes are bit-identical profiling on vs off. Results:
+    # obs.profile.global_profile.summary()/roofline(), the
+    # lgbmtpu_profile_* OpenMetrics families, bench JSON
+    # device_seconds_by_tag/roofline, and a device lane in the Chrome
+    # trace export.
+    tpu_profile: str = "off"
+    tpu_profile_window: int = 5
     # persistent XLA compile cache (compile_cache.py; ROADMAP item 2 —
     # kill cold start). "auto" (default) arms
     # jax.config.jax_compilation_cache_dir at the train/serve entry

@@ -12,6 +12,8 @@ import numpy as np
 from . import callback as callback_mod
 from .basic import Booster, Dataset, LightGBMError
 from .config import Config
+from .obs.flightrec import global_flightrec
+from .obs.health import HealthError
 from .resilience import checkpoint as ckpt_mod
 from .resilience import faults as faults_mod
 from .resilience.errors import EXIT_PREEMPTED
@@ -98,6 +100,9 @@ def train(params: Dict[str, Any], train_set: Dataset,
             from . import log
             log.info(f"resumed from checkpoint {ckpt_path} at iteration "
                      f"{start_iteration}/{num_boost_round}")
+            if global_flightrec.armed:
+                global_flightrec.record("resume", iteration=start_iteration,
+                                        path=ckpt_path)
     preempt = {"flag": False}
     prev_sigterm = _install_sigterm(preempt) if ckpt_path else None
 
@@ -149,12 +154,25 @@ def train(params: Dict[str, Any], train_set: Dataset,
                         booster.best_score.setdefault(
                             item[0], {})[item[1]] = item[2]
                     break
+            except HealthError as exc:
+                # black box first (obs/flightrec.py): the dump carries
+                # the offending iteration's events, then the structured
+                # alarm propagates unchanged
+                if global_flightrec.armed:
+                    global_flightrec.record(
+                        "health_anomaly", iteration=i,
+                        error=type(exc).__name__, detail=str(exc)[:500])
+                    global_flightrec.maybe_dump(reason=type(exc).__name__)
+                raise
             except (KeyboardInterrupt, SystemExit) as exc:
                 # interrupt safety: finalize and hand back the
                 # best-so-far booster (trees are only appended at
                 # iteration granularity, so the model is consistent)
                 # instead of propagating with a half-updated booster
                 interrupted = True
+                if global_flightrec.armed:
+                    global_flightrec.record("interrupted", iteration=i,
+                                            error=type(exc).__name__)
                 from . import log
                 log.warning(
                     f"training interrupted at iteration {i} "
@@ -176,13 +194,20 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     ckpt_mod.save_checkpoint(booster, ckpt_path,
                                              num_boost_round,
                                              finished=should_stop)
+                    if global_flightrec.armed:
+                        global_flightrec.record("checkpoint",
+                                                iteration=i + 1,
+                                                path=ckpt_path)
                 if preempt["flag"]:
                     from . import log
                     log.warning(
                         f"preempted: snapshot at iteration {i + 1} "
                         f"written to {ckpt_path}; exiting with code "
                         f"{EXIT_PREEMPTED}")
-                    _flush_obs_egress()
+                    if global_flightrec.armed:
+                        global_flightrec.record("preempt", iteration=i + 1,
+                                                exit_code=EXIT_PREEMPTED)
+                    _flush_obs_egress(reason="preempt")
                     raise SystemExit(EXIT_PREEMPTED)
             if should_stop:
                 break
@@ -197,7 +222,10 @@ def train(params: Dict[str, Any], train_set: Dataset,
         if ckpt_path and preempt["flag"] and not interrupted:
             ckpt_mod.save_checkpoint(booster, ckpt_path,
                                      num_boost_round, finished=True)
-            _flush_obs_egress()
+            if global_flightrec.armed:
+                global_flightrec.record("preempt", exit_code=EXIT_PREEMPTED,
+                                        path=ckpt_path)
+            _flush_obs_egress(reason="preempt")
             raise SystemExit(EXIT_PREEMPTED)
     finally:
         if prev_sigterm is not None:
@@ -207,7 +235,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
                 pass
         restore_telemetry()
     if interrupted:
-        _flush_obs_egress()
+        _flush_obs_egress(reason="interrupted")
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration()
     return booster
@@ -227,10 +255,11 @@ def _install_sigterm(preempt: Dict[str, bool]):
         return None
 
 
-def _flush_obs_egress() -> None:
+def _flush_obs_egress(reason: str = "egress") -> None:
     """Push pending observability out before an abnormal return: the
-    OpenMetrics textfile (if armed) and the Chrome trace (if the tracer
-    was given a path) must reflect the run that just died."""
+    OpenMetrics textfile (if armed), the Chrome trace (if the tracer
+    was given a path) and the flight-recorder black box (if armed) must
+    reflect the run that just died."""
     try:
         from .obs.export import global_flusher
         global_flusher.maybe_flush(force=True)
@@ -238,6 +267,7 @@ def _flush_obs_egress() -> None:
         if global_tracer.enabled and getattr(global_tracer, "trace_path",
                                              None):
             global_tracer.export_chrome(global_tracer.trace_path)
+        global_flightrec.maybe_dump(reason=reason)
     except Exception:
         pass  # telemetry egress must never mask the real outcome
 

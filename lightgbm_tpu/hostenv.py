@@ -40,6 +40,39 @@ def host_labels() -> Dict[str, str]:
     return labels
 
 
+#: Nominal per-chip peak throughputs feeding the roofline layer
+#: (obs/profile.py) and perf-gate check 11. Deliberately conservative
+#: round numbers — docs/PERF_PROJECTION.md records the sources — and
+#: env-overridable (LGBM_TPU_PEAK_BYTES_PER_S / LGBM_TPU_PEAK_FLOPS)
+#: so a real part's datasheet numbers can be pinned per deployment.
+_PLATFORM_PEAKS: Dict[str, Dict[str, float]] = {
+    # one modern x86 core: ~50 GF/s fp32 FMA, ~20 GB/s streaming DRAM
+    "cpu": {"flops_per_s": 5.0e10, "bytes_per_s": 2.0e10},
+    # TPU v4 class: 275 TF/s bf16, 1.2 TB/s HBM2e
+    "tpu": {"flops_per_s": 2.75e14, "bytes_per_s": 1.2e12},
+    # A100 class: 156 TF/s tf32, 2.0 TB/s HBM2e
+    "gpu": {"flops_per_s": 1.56e14, "bytes_per_s": 2.0e12},
+}
+
+
+def platform_peaks(platform: str) -> Dict[str, float]:
+    """``{"flops_per_s", "bytes_per_s"}`` roofline peaks for a backend
+    platform string (unknown platforms get the TPU row — accelerator
+    first). Passive: the caller supplies the platform; this module
+    never probes a backend (module docstring)."""
+    peaks = dict(_PLATFORM_PEAKS.get(
+        str(platform).lower(), _PLATFORM_PEAKS["tpu"]))
+    for env, key in (("LGBM_TPU_PEAK_FLOPS", "flops_per_s"),
+                     ("LGBM_TPU_PEAK_BYTES_PER_S", "bytes_per_s")):
+        raw = os.environ.get(env, "")
+        if raw:
+            try:
+                peaks[key] = float(raw)
+            except ValueError:
+                pass
+    return peaks
+
+
 def cpu_child_env(n_devices: Optional[int] = None,
                   base: Optional[Dict[str, str]] = None) -> Dict[str, str]:
     """A copy of the environment made safe for a CPU-only child.

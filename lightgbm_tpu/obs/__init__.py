@@ -26,10 +26,23 @@ common.h:980,1044; global_timer dump at src/boosting/gbdt.cpp:29):
   (``tpu_health=off/warn/error`` — warn records, error raises
   ``DriftError``/``NonFiniteError``), per-iteration NaN/Inf sentinels
   folded into the fused programs, and an eval-loss anomaly detector.
+- ``obs.profile`` — device-time attribution: ``jax.profiler``-backed
+  capture windows (``tpu_profile=off/window/bench`` +
+  ``LGBM_TPU_PROFILE_DIR``) parsed into per-program device-busy
+  seconds keyed to the obs tags, a profiler-free
+  ``block_until_ready`` fallback for CPU CI, and the roofline layer
+  (achieved bytes/s + utilization vs ``hostenv.platform_peaks`` + a
+  memory/compute-bound verdict per tag).
+- ``obs.flightrec`` — crash flight recorder: a bounded ring of recent
+  structured events (iterations, serve outcomes, health anomalies,
+  fault injections, checkpoint/resume transitions) atomically dumped
+  on DriftError/NonFiniteError/SIGTERM/exit-75/exit and on demand
+  (``LGBM_TPU_FLIGHTREC=/path.json``).
 - ``obs.export`` — OpenMetrics egress: the Prometheus text-format
   renderer over all of the above, the ``/metrics``+``/healthz``+
-  ``/readyz`` HTTP endpoint, and the ``LGBM_TPU_METRICS_FILE``
-  textfile flusher.
+  ``/readyz`` HTTP endpoint (Accept-negotiated OpenMetrics vs
+  Prometheus content type, ``# EOF``-terminated), and the
+  ``LGBM_TPU_METRICS_FILE`` textfile flusher.
 
 All are disabled by default and their hot-path guards are single
 attribute checks — training with telemetry off records nothing and
@@ -47,6 +60,10 @@ from .xla import (XlaIntrospector, aot_cost_summary,  # noqa: F401
                   global_xla, instrumented_jit)
 from .health import (DriftError, HealthError,  # noqa: F401
                      HealthRegistry, NonFiniteError, global_health)
+from .profile import (ProfileRegistry, global_profile,  # noqa: F401
+                      parse_trace_events)
+from .flightrec import (FlightRecorder, global_flightrec,  # noqa: F401
+                        validate_dump)
 from .export import (MetricsHTTPEndpoint,  # noqa: F401
                      MetricsTextfileFlusher, global_flusher,
                      render_openmetrics)
@@ -60,6 +77,8 @@ __all__ = ["Tracer", "global_tracer", "LatencyReservoir",
            "XlaIntrospector", "global_xla", "instrumented_jit",
            "aot_cost_summary", "HealthError", "DriftError",
            "NonFiniteError", "HealthRegistry", "global_health",
+           "ProfileRegistry", "global_profile", "parse_trace_events",
+           "FlightRecorder", "global_flightrec", "validate_dump",
            "MetricsHTTPEndpoint",
            "MetricsTextfileFlusher", "global_flusher",
            "render_openmetrics"]

@@ -41,6 +41,21 @@ from .metrics import global_metrics
 # Prometheus text exposition format 0.0.4 (the content type Prometheus'
 # scraper negotiates for the text format)
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+# OpenMetrics 1.0: same rendered body (the document ends with the
+# required `# EOF` terminator and stays within the common subset), so
+# negotiation only changes the advertised content type
+OPENMETRICS_CONTENT_TYPE = ("application/openmetrics-text; "
+                            "version=1.0.0; charset=utf-8")
+
+
+def negotiate_content_type(accept: Optional[str]) -> str:
+    """Content type for a scrape given its Accept header: OpenMetrics
+    when the scraper asks for ``application/openmetrics-text``
+    (Prometheus does once per target to probe support), the classic
+    0.0.4 text type otherwise."""
+    return (OPENMETRICS_CONTENT_TYPE
+            if "application/openmetrics-text" in (accept or "")
+            else CONTENT_TYPE)
 
 _NAME_OK = re.compile(r"[^a-zA-Z0-9_:]")
 
@@ -86,7 +101,9 @@ class _Doc:
         self.lines.append(f"{n} {_fmt(value)}")
 
     def text(self) -> str:
-        return "\n".join(self.lines) + "\n"
+        # `# EOF` is the OpenMetrics 1.0 terminator; Prometheus 0.0.4
+        # parsers treat it as a comment, so one body serves both
+        return "\n".join(self.lines + ["# EOF"]) + "\n"
 
 
 def render_openmetrics(registry=None,
@@ -289,6 +306,55 @@ def render_openmetrics(registry=None,
             doc.sample("lgbmtpu_xla_bytes_accessed", "gauge",
                        t["bytes_accessed"], labels={"tag": tag})
 
+    # device-time attribution + roofline (obs/profile.py; emits nothing
+    # until a tpu_profile window captured something)
+    from .profile import global_profile
+    ps = global_profile.summary()
+    if ps.get("device_seconds_by_tag"):
+        doc.sample("lgbmtpu_profile_window_seconds", "gauge",
+                   ps.get("window_wall_s", 0.0),
+                   help_text="cumulative wall time of tpu_profile "
+                             "capture windows")
+        if "coverage" in ps:
+            doc.sample("lgbmtpu_profile_coverage", "gauge",
+                       ps["coverage"],
+                       help_text="attributed device seconds / window "
+                                 "wall time (perf-gate check 11 band)")
+        src = ps.get("source", "fallback")
+        for tag in sorted(ps["device_seconds_by_tag"]):
+            doc.sample("lgbmtpu_profile_device_seconds_total", "counter",
+                       ps["device_seconds_by_tag"][tag],
+                       labels={"tag": tag, "source": src},
+                       help_text="measured device-busy seconds per "
+                                 "program tag (jax.profiler trace or "
+                                 "the block_until_ready fallback)")
+        for tag in sorted(ps.get("calls_by_tag", {})):
+            doc.sample("lgbmtpu_profile_calls_total", "counter",
+                       ps["calls_by_tag"][tag], labels={"tag": tag})
+        rl = global_profile.last_roofline
+        if rl is None:
+            try:
+                rl = global_profile.roofline()
+            except Exception:
+                rl = None
+        if isinstance(rl, dict):
+            for tag in sorted(rl.get("by_tag", {})):
+                row = rl["by_tag"][tag]
+                if "achieved_bytes_per_s" in row:
+                    doc.sample("lgbmtpu_profile_achieved_bytes_per_second",
+                               "gauge", row["achieved_bytes_per_s"],
+                               labels={"tag": tag},
+                               help_text="achieved HBM bytes/s per tag "
+                                         "vs hostenv.platform_peaks")
+                for res, key in (("bytes", "bytes_utilization"),
+                                 ("flops", "flops_utilization")):
+                    if key in row:
+                        doc.sample("lgbmtpu_profile_utilization", "gauge",
+                                   row[key],
+                                   labels={"tag": tag, "resource": res},
+                                   help_text="achieved/peak throughput "
+                                             "fraction (roofline)")
+
     # training-health families (obs/health.py; empty summary — health
     # never armed — emits nothing, asserted by tools/check_health.py)
     from .health import global_health
@@ -381,7 +447,8 @@ class MetricsHTTPEndpoint:
                     except Exception as exc:
                         self._send(500, f"render failed: {exc}\n".encode())
                         return
-                    self._send(200, body, CONTENT_TYPE)
+                    self._send(200, body, negotiate_content_type(
+                        self.headers.get("Accept")))
                 elif path == "/healthz":
                     self._send(200, b"ok\n")
                 elif path == "/readyz":

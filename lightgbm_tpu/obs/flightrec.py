@@ -1,0 +1,247 @@
+"""Crash flight recorder: a bounded ring of recent structured events,
+atomically dumped to disk on DriftError / NonFiniteError / SIGTERM /
+exit-75 / process exit and on demand — so every postmortem ships with a
+black box (ISSUE 16).
+
+Event sources (all gated on a single ``armed`` attribute check so the
+disabled path costs one branch):
+
+* ``boosting.train_one_iter`` — one ``iteration`` event per call;
+* ``engine.train`` — ``resume`` / ``checkpoint`` / ``preempt`` /
+  ``sigterm`` transitions and ``health_anomaly`` on a propagating
+  DriftError/NonFiniteError (the anomaly triggers an immediate dump);
+* ``serve/server.py`` — per-request outcomes including degradation
+  errors (load shed, deadline, circuit open);
+* ``resilience/faults.py`` — every injected fault.
+
+Arming: ``LGBM_TPU_FLIGHTREC=/path/dump.json`` (dump target; a bare
+``1`` records to the default path ``flightrec.json`` in the cwd) or
+``global_flightrec.enable(path)``. ``LGBM_TPU_FLIGHTREC_EVENTS`` sizes
+the ring (default 512). Recording never raises and dumping never masks
+the real outcome — the same contract as the rest of the obs stack.
+
+Dump format (``validate_dump`` checks it; tools/check_profile.py and
+tests/test_profile.py consume it)::
+
+    {"format": "lightgbm_tpu.flightrec.v1",
+     "reason": "<why the dump happened>",
+     "dumped_at_unix": <float>,
+     "host": {...hostenv.host_labels()...},
+     "n_recorded": <total events ever recorded>,
+     "n_dropped": <events evicted from the ring>,
+     "events": [{"seq": int, "ts_unix": float, "kind": str,
+                 "iteration": int?, ...payload}, ...]}
+
+Writes are atomic (tmp + ``os.replace``) so a crash mid-dump never
+leaves a truncated black box.
+"""
+
+from __future__ import annotations
+
+import atexit
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+FORMAT = "lightgbm_tpu.flightrec.v1"
+DEFAULT_CAPACITY = 512
+_ENV_PATH = "LGBM_TPU_FLIGHTREC"
+_ENV_CAPACITY = "LGBM_TPU_FLIGHTREC_EVENTS"
+
+
+def _jsonable(value: Any) -> Any:
+    """Best-effort JSON-safe coercion; the recorder must accept any
+    payload without raising."""
+    if value is None or isinstance(value, (bool, int, float, str)):
+        return value
+    if isinstance(value, (list, tuple)):
+        return [_jsonable(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _jsonable(v) for k, v in value.items()}
+    try:
+        return float(value)  # numpy scalars
+    except Exception:
+        return repr(value)[:200]
+
+
+class FlightRecorder:
+    """Bounded in-memory ring of structured events with atomic dumps.
+
+    ``armed`` is the one-attribute fast gate every instrumentation site
+    checks before paying for an event append."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        self.armed = False
+        self.path: Optional[str] = None
+        self._lock = threading.Lock()
+        self._ring: collections.deque = collections.deque(maxlen=capacity)
+        self._seq = 0
+        self._n_dumps = 0
+        self._atexit_installed = False
+
+    # -- lifecycle ----------------------------------------------------
+    def enable(self, path: Optional[str] = None,
+               capacity: Optional[int] = None) -> None:
+        """Arm recording. ``path`` is the default dump target; when set,
+        an atexit hook dumps whatever the ring holds at process exit
+        (reason ``atexit``) unless a dump already happened."""
+        with self._lock:
+            if capacity is not None and \
+                    capacity != self._ring.maxlen:
+                self._ring = collections.deque(
+                    self._ring, maxlen=max(int(capacity), 8))
+            if path:
+                self.path = path
+        self.armed = True
+        if self.path and not self._atexit_installed:
+            self._atexit_installed = True
+            atexit.register(self._at_exit)
+
+    def disable(self) -> None:
+        self.armed = False
+
+    def reset(self) -> None:
+        """Testing hook: drop all state but keep the atexit handle."""
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self._n_dumps = 0
+        self.armed = False
+        self.path = None
+
+    # -- recording ----------------------------------------------------
+    def record(self, kind: str, iteration: Optional[int] = None,
+               **payload: Any) -> None:
+        """Append one event; silently drops the oldest when full.
+        Never raises (telemetry must never kill training/serving)."""
+        if not self.armed:
+            return
+        try:
+            ev: Dict[str, Any] = {"seq": self._seq, "ts_unix": time.time(),
+                                  "kind": str(kind)}
+            if iteration is not None:
+                ev["iteration"] = int(iteration)
+            for k, v in payload.items():
+                ev[k] = _jsonable(v)
+            with self._lock:
+                ev["seq"] = self._seq
+                self._seq += 1
+                self._ring.append(ev)
+        except Exception:
+            pass
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._ring)
+
+    # -- dumping ------------------------------------------------------
+    def dump(self, path: Optional[str] = None,
+             reason: str = "on_demand") -> Optional[str]:
+        """Atomically write the ring to ``path`` (default: the armed
+        path). Returns the written path, or None when there is nowhere
+        to write. Never raises."""
+        target = path or self.path
+        if not target:
+            return None
+        try:
+            with self._lock:
+                events = list(self._ring)
+                seq = self._seq
+            try:
+                from ..hostenv import host_labels
+                host = host_labels()
+            except Exception:
+                host = {}
+            doc = {"format": FORMAT, "reason": str(reason),
+                   "dumped_at_unix": time.time(), "host": host,
+                   "n_recorded": seq,
+                   "n_dropped": max(seq - len(events), 0),
+                   "events": events}
+            parent = os.path.dirname(os.path.abspath(target))
+            if parent and not os.path.isdir(parent):
+                os.makedirs(parent, exist_ok=True)
+            tmp = target + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(doc, fh, indent=1)
+                fh.write("\n")
+            os.replace(tmp, target)
+            with self._lock:
+                self._n_dumps += 1
+            return target
+        except Exception:
+            return None
+
+    def maybe_dump(self, reason: str = "on_demand") -> Optional[str]:
+        """Dump iff armed with a target and at least one event; the
+        crash-path helper (exit-75, health anomalies, atexit)."""
+        if not self.armed:
+            return None
+        with self._lock:
+            empty = not self._ring
+        if empty:
+            return None
+        return self.dump(reason=reason)
+
+    def _at_exit(self) -> None:
+        # the black box flushes at process exit when nothing dumped it
+        # earlier — a hard crash postmortem still has the tail events
+        if self.armed and self._n_dumps == 0:
+            self.maybe_dump(reason="atexit")
+
+
+def validate_dump(doc: Any) -> List[str]:
+    """-> list of schema violations (empty = valid). Importable by
+    tools/check_profile.py and tests; no side effects."""
+    errors: List[str] = []
+    if not isinstance(doc, dict):
+        return [f"dump is {type(doc).__name__}, expected object"]
+    if doc.get("format") != FORMAT:
+        errors.append(f"format is {doc.get('format')!r}, expected {FORMAT!r}")
+    if not isinstance(doc.get("reason"), str) or not doc.get("reason"):
+        errors.append("missing non-empty string 'reason'")
+    if not isinstance(doc.get("dumped_at_unix"), (int, float)):
+        errors.append("missing numeric 'dumped_at_unix'")
+    for key in ("n_recorded", "n_dropped"):
+        if not isinstance(doc.get(key), int) or doc[key] < 0:
+            errors.append(f"missing non-negative int {key!r}")
+    events = doc.get("events")
+    if not isinstance(events, list):
+        return errors + ["missing 'events' list"]
+    last_seq = -1
+    for i, ev in enumerate(events):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i} is not an object")
+            continue
+        if not isinstance(ev.get("kind"), str) or not ev["kind"]:
+            errors.append(f"event {i} lacks a string 'kind'")
+        if not isinstance(ev.get("ts_unix"), (int, float)):
+            errors.append(f"event {i} lacks numeric 'ts_unix'")
+        seq = ev.get("seq")
+        if not isinstance(seq, int):
+            errors.append(f"event {i} lacks int 'seq'")
+        elif seq <= last_seq:
+            errors.append(f"event {i} seq {seq} not increasing "
+                          f"(prev {last_seq})")
+        else:
+            last_seq = seq
+        if "iteration" in ev and not isinstance(ev["iteration"], int):
+            errors.append(f"event {i} has non-int 'iteration'")
+    return errors
+
+
+def _capacity_from_env() -> int:
+    try:
+        return max(int(os.environ.get(_ENV_CAPACITY, DEFAULT_CAPACITY)), 8)
+    except ValueError:
+        return DEFAULT_CAPACITY
+
+
+global_flightrec = FlightRecorder(capacity=_capacity_from_env())
+
+_env_target = os.environ.get(_ENV_PATH, "")
+if _env_target and _env_target not in ("0", "false", "off"):
+    global_flightrec.enable(
+        path=_env_target if _env_target != "1" else "flightrec.json")

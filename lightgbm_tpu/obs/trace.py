@@ -270,7 +270,9 @@ class Tracer:
     def chrome_events(self) -> List[Dict[str, Any]]:
         """Completed spans as Chrome trace-event dicts (phase "X",
         microsecond timestamps), sorted by start time — prefixed with
-        the ``ph: "M"`` process/thread metadata events."""
+        the ``ph: "M"`` process/thread metadata events. Device slices
+        captured by obs/profile.py ride along on their own pid so host
+        spans and device programs render on one Perfetto timeline."""
         pid = os.getpid()
         with self._lock:
             snapshot = list(self._events)
@@ -292,6 +294,13 @@ class Tracer:
                 "tid": tid,
                 "args": args,
             })
+        try:
+            # device lane (same perf_counter_ns clock as the spans; the
+            # profile registry rebases profiler-sourced slices onto it)
+            from .profile import global_profile
+            events.extend(global_profile.device_lane_events(pid + 1))
+        except Exception:
+            pass  # the host trace must export even if the lane cannot
         return events
 
     def export_chrome(self, path: str) -> None:

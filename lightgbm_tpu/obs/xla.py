@@ -297,7 +297,11 @@ def instrumented_jit(tag: str, fn: Callable, phase: Optional[str] = None,
     import jax
     from ..compile_cache import donation_allowed
     from .health import global_health
+    from .profile import global_profile
     reg = registry if registry is not None else global_xla
+    # device-time attribution (obs/profile.py): the jitted function name
+    # is what the profiler trace shows, so map it back to the obs tag
+    global_profile.register_tag(tag, phase, getattr(fn, "__name__", tag))
     if not donation_allowed():
         # One version-gated policy (compile_cache.donation_allowed):
         # buffer donation segfaults on executables deserialized from the
@@ -334,6 +338,10 @@ def instrumented_jit(tag: str, fn: Callable, phase: Optional[str] = None,
             reg.note_compile(tag, phase, _shape_label(key), dt_compile,
                              entry, trace_s=t1 - t0,
                              cache_hit=_cache_hit_count[0] > hits0)
+        if global_profile.capturing:
+            # retain (executable, latest args) for the window-close
+            # block_until_ready micro-reruns; dropped at stop_window
+            global_profile.register_entry(tag, phase, entry, args, kwargs)
         try:
             return entry(*args, **kwargs)
         except Exception as exc:
@@ -343,6 +351,11 @@ def instrumented_jit(tag: str, fn: Callable, phase: Optional[str] = None,
 
     def wrapper(*args, **kwargs):
         try:
+            if global_profile.capturing:
+                # open profile window: sync-timed dispatch attributes
+                # this call's device time to the tag (values unchanged)
+                return global_profile.timed_call(tag, phase, _dispatch,
+                                                 args, kwargs)
             return _dispatch(*args, **kwargs)
         finally:
             # runtime collective attribution (obs/health.py): AFTER the

@@ -105,6 +105,11 @@ class FaultPlan:
         from ..obs.metrics import global_metrics
         global_metrics.inc_counter("resilience/fault_injections")
         global_metrics.inc_counter(f"resilience/fault_{kind}")
+        from ..obs.flightrec import global_flightrec
+        if global_flightrec.armed:
+            # the black box records every injected fault so a postmortem
+            # distinguishes induced failures from organic ones
+            global_flightrec.record("fault_injection", fault=kind)
 
     def fired(self, kind: str) -> int:
         with self._lock:

@@ -47,6 +47,7 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from ..obs.flightrec import global_flightrec
 from ..obs.metrics import global_metrics
 from ..obs.trace import global_tracer
 from ..resilience.degrade import CircuitBreaker, backoff_delays
@@ -168,6 +169,10 @@ class ModelServer:
             # still accepts a single oversized request, mirroring the
             # batcher)
             global_metrics.inc_counter("resilience/load_shed")
+            if global_flightrec.armed:
+                global_flightrec.record("serve_request", model=name,
+                                        rows=rows, ok=False,
+                                        error="ServerOverloaded")
             raise ServerOverloaded(
                 f"admission queue full ({self._queued_rows} rows "
                 f"pending, request adds {rows} > "
@@ -195,12 +200,25 @@ class ModelServer:
         try:
             raw = await self._dispatch_with_retry(entry, x, rt, deadline,
                                                   br, loop, lowlat)
-        except (DeadlineExceeded, asyncio.CancelledError):
+        except (DeadlineExceeded, asyncio.CancelledError) as exc:
             # not a verdict on the model: a half-open PROBE that died
             # this way frees its slot so the breaker can probe again
             # (a closed-state admission holds no slot to free)
             if br is not None and probe_held:
                 br.release_probe()
+            if global_flightrec.armed:
+                global_flightrec.record("serve_request", model=name,
+                                        rows=rows, ok=False,
+                                        error=type(exc).__name__)
+            raise
+        except Exception as exc:
+            # circuit-open / transient-exhausted / dispatch faults: the
+            # black box keeps the outcome even though the error routes
+            # back to the caller
+            if global_flightrec.armed:
+                global_flightrec.record("serve_request", model=name,
+                                        rows=rows, ok=False,
+                                        error=type(exc).__name__)
             raise
         finally:
             self._queued_rows -= rows
@@ -212,6 +230,11 @@ class ModelServer:
         global_metrics.inc_counter("serve/rows", x.shape[0])
         global_metrics.note_latency("serve/request",
                                     time.perf_counter() - t0)
+        if global_flightrec.armed:
+            global_flightrec.record(
+                "serve_request", model=name, rows=rows, ok=True,
+                lowlat=bool(lowlat),
+                latency_ms=round((time.perf_counter() - t0) * 1e3, 3))
         if rt is not None:
             args = {"trace_id": rt.trace_id, "path": rt.path,
                     "rows": int(x.shape[0]),

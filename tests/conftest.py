@@ -48,7 +48,7 @@ QUICK_FILES = {
     "test_predict_engine.py", "test_serve.py", "test_codegen.py",
     "test_bin_pack.py", "test_perf_gate.py", "test_memory_model.py",
     "test_obs_export.py", "test_health.py", "test_resilience.py",
-    "test_stream.py", "test_coldstart.py",
+    "test_stream.py", "test_coldstart.py", "test_profile.py",
 }
 
 

@@ -1,0 +1,174 @@
+#!/usr/bin/env python
+"""Aggregate the recorded bench trajectory into a trend report.
+
+The driver leaves one ``BENCH_rNN.json`` / ``MULTICHIP_rNN.json`` per
+round in the repo root — either a bare bench contract record or the
+driver's ``{"n", "cmd", "rc", "tail"}`` wrapper whose ``tail`` embeds
+the JSON line bench.py printed (the same shapes
+tools/check_perf_gate.py parses). This tool rolls the whole history
+into one table, per (metric, platform):
+
+- one row per round: value, vs_baseline, and the observability
+  extras a round carried (hist-traffic reduction, compile seconds,
+  device-time coverage from the obs/profile roofline record);
+- the best recorded value is the floor; any later same-platform round
+  more than ``bench.max_value_drop`` (tools/perf_floor.json) below it
+  is flagged ``REGRESSION`` — the same band perf-gate check 3 enforces,
+  but over the WHOLE trajectory so a slow bleed across rounds is
+  visible even when each step stays inside the gate;
+- ``--json`` emits the machine-readable document instead of markdown;
+  ``--out PATH`` writes to a file instead of stdout.
+
+Exit 0 always: this is a report, not a gate (check_perf_gate.py is
+the gate). Usage: python tools/bench_report.py [--json] [--out PATH]
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _platform_of(unit: str) -> str:
+    m = re.search(r"platform=(\w+)", unit or "")
+    return m.group(1) if m else "tpu"
+
+
+def _fish_record(blob: Any) -> Optional[Dict[str, Any]]:
+    """The bench contract record out of either file shape."""
+    if isinstance(blob, dict) and isinstance(blob.get("metric"), str):
+        return blob
+    if not isinstance(blob, dict):
+        return None
+    parsed = blob.get("parsed")
+    if isinstance(parsed, dict) and isinstance(parsed.get("metric"), str):
+        return parsed
+    for line in reversed(str(blob.get("tail", "")).splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec.get("metric"), str):
+                return rec
+    return None
+
+
+def collect(repo: str = REPO) -> List[Tuple[str, Dict[str, Any]]]:
+    """[(filename, record)] for every round that left a contract
+    record, oldest first (BENCH_* then MULTICHIP_*, each sorted)."""
+    out = []
+    for pattern in ("BENCH_*.json", "MULTICHIP_*.json"):
+        for path in sorted(glob.glob(os.path.join(repo, pattern))):
+            try:
+                with open(path) as fh:
+                    rec = _fish_record(json.load(fh))
+            except (OSError, ValueError):
+                continue
+            if rec is not None:
+                out.append((os.path.basename(path), rec))
+    return out
+
+
+def build_report(records: List[Tuple[str, Dict[str, Any]]],
+                 max_drop: float) -> Dict[str, Any]:
+    """Group per (metric, platform), compute the floor, flag rounds
+    below floor x (1 - max_drop)."""
+    groups: Dict[Tuple[str, str], List[Dict[str, Any]]] = {}
+    for fname, rec in records:
+        key = (str(rec.get("metric")), _platform_of(rec.get("unit", "")))
+        roofline = rec.get("roofline") or {}
+        row = {
+            "file": fname,
+            "value": float(rec.get("value", 0.0) or 0.0),
+            "vs_baseline": rec.get("vs_baseline"),
+            "hist_bytes_reduction": rec.get("hist_bytes_reduction"),
+            "compile_s_total": rec.get("compile_s_total"),
+            "profile_coverage": roofline.get("coverage"),
+        }
+        groups.setdefault(key, []).append(row)
+    report: Dict[str, Any] = {"max_value_drop": max_drop, "groups": [],
+                              "regressions": []}
+    for (metric, platform), rows in sorted(groups.items()):
+        best = max(r["value"] for r in rows)
+        floor = best * (1.0 - max_drop)
+        for r in rows:
+            r["regression"] = bool(best > 0 and r["value"] < floor)
+            if r["regression"]:
+                report["regressions"].append(
+                    f"{r['file']}: {metric}[{platform}] value "
+                    f"{r['value']:.4f} is >{max_drop:.0%} below the "
+                    f"recorded best {best:.4f}")
+        report["groups"].append({
+            "metric": metric, "platform": platform, "best": best,
+            "latest": rows[-1]["value"], "rows": rows})
+    return report
+
+
+def _fmt(value: Any, spec: str = "{:.4f}") -> str:
+    return "-" if value is None else spec.format(value)
+
+
+def render_markdown(report: Dict[str, Any]) -> str:
+    lines = ["# Bench trajectory", ""]
+    for group in report["groups"]:
+        lines.append(f"## {group['metric']} — {group['platform']} "
+                     f"(best {group['best']:.4f}, latest "
+                     f"{group['latest']:.4f})")
+        lines.append("")
+        lines.append("| round | value | vs_baseline | hist reduction | "
+                     "compile s | profile coverage | flag |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for r in group["rows"]:
+            lines.append(
+                f"| {r['file']} | {r['value']:.4f} | "
+                f"{_fmt(r['vs_baseline'])} | "
+                f"{_fmt(r['hist_bytes_reduction'], '{:.2f}x')} | "
+                f"{_fmt(r['compile_s_total'], '{:.2f}')} | "
+                f"{_fmt(r['profile_coverage'], '{:.1%}')} | "
+                f"{'REGRESSION' if r['regression'] else ''} |")
+        lines.append("")
+    if report["regressions"]:
+        lines.append(f"**{len(report['regressions'])} flagged "
+                     "round(s):**")
+        lines.extend(f"- {msg}" for msg in report["regressions"])
+    elif report["groups"]:
+        lines.append("No rounds below the floor band.")
+    else:
+        lines.append("No bench records found.")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    as_json = "--json" in argv
+    out_path = None
+    if "--out" in argv:
+        out_path = argv[argv.index("--out") + 1]
+    try:
+        with open(os.path.join(REPO, "tools", "perf_floor.json")) as fh:
+            max_drop = float(json.load(fh)["bench"]["max_value_drop"])
+    except (OSError, ValueError, KeyError):
+        max_drop = 0.10
+    report = build_report(collect(), max_drop)
+    text = (json.dumps(report, indent=2) + "\n" if as_json
+            else render_markdown(report))
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(text)
+        print(f"# wrote {out_path} ({len(report['groups'])} group(s), "
+              f"{len(report['regressions'])} flagged)")
+    else:
+        print(text, end="")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

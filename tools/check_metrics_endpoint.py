@@ -12,7 +12,12 @@ Trains a small model, starts the in-process async server plus its
    valid Prometheus text format LINE BY LINE (every sample parses,
    every family has a TYPE header, summary quantile labels present)
    and exposes the request-latency quantiles, the serve/registry
-   counters, and the predict throughput series.
+   counters, and the predict throughput series;
+4. the exposition is ``# EOF``-terminated (OpenMetrics 1.0 — the
+   terminator parses as a comment under Prometheus 0.0.4, so one body
+   serves both) and the endpoint negotiates the content type off the
+   Accept header: ``application/openmetrics-text`` requests get the
+   OpenMetrics media type, everything else the 0.0.4 text type.
 
 Exit 0 = pass. Usage: python tools/check_metrics_endpoint.py
 """
@@ -103,13 +108,20 @@ def _split_labels(body: str) -> List[str]:
     return out
 
 
-def _get(port: int, path: str) -> Tuple[int, str]:
+def _get(port: int, path: str,
+         accept: str = None) -> Tuple[int, str, str]:
+    """-> (status, body, content-type); `accept` rides the Accept
+    header so the negotiation checks can ask for OpenMetrics."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        headers={"Accept": accept} if accept else {})
     try:
-        with urllib.request.urlopen(
-                f"http://127.0.0.1:{port}{path}", timeout=5) as resp:
-            return resp.status, resp.read().decode()
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return (resp.status, resp.read().decode(),
+                    resp.headers.get("Content-Type", ""))
     except urllib.error.HTTPError as exc:
-        return exc.code, exc.read().decode()
+        return (exc.code, exc.read().decode(),
+                exc.headers.get("Content-Type", ""))
 
 
 def main() -> int:
@@ -132,7 +144,7 @@ def main() -> int:
     endpoint = server.start_metrics_endpoint(port=0)
     failures = 0
 
-    code, _ = _get(endpoint.port, "/healthz")
+    code, _, _ = _get(endpoint.port, "/healthz")
     if code != 200:
         print(f"FAIL: /healthz returned {code} before warm")
         failures += 1
@@ -154,10 +166,10 @@ def main() -> int:
     saw_unready = False
     deadline = time.time() + 10
     while warm_thread.is_alive() and time.time() < deadline:
-        code, _ = _get(endpoint.port, "/readyz")
+        code, _, _ = _get(endpoint.port, "/readyz")
         if code == 503:
             saw_unready = True
-        code_h, _ = _get(endpoint.port, "/healthz")
+        code_h, _, _ = _get(endpoint.port, "/healthz")
         if code_h != 200:
             print(f"FAIL: /healthz returned {code_h} during warm")
             failures += 1
@@ -168,7 +180,7 @@ def main() -> int:
     if not saw_unready:
         print("FAIL: /readyz never returned 503 during warm()")
         failures += 1
-    code, _ = _get(endpoint.port, "/readyz")
+    code, _, _ = _get(endpoint.port, "/readyz")
     if code != 200:
         print(f"FAIL: /readyz returned {code} after warm completed")
         failures += 1
@@ -182,7 +194,7 @@ def main() -> int:
 
     asyncio.run(run())
 
-    code, body = _get(endpoint.port, "/metrics")
+    code, body, ctype = _get(endpoint.port, "/metrics")
     if code != 200:
         print(f"FAIL: /metrics returned {code}")
         failures += 1
@@ -205,6 +217,25 @@ def main() -> int:
         if needle not in body:
             print(f"FAIL: /metrics is missing {needle!r}")
             failures += 1
+
+    # OpenMetrics terminator + Accept negotiation (obs/export.py)
+    if body and body.splitlines()[-1].strip() != "# EOF":
+        print("FAIL: /metrics exposition is not '# EOF'-terminated")
+        failures += 1
+    if not ctype.startswith("text/plain"):
+        print(f"FAIL: default /metrics content type {ctype!r} is not "
+              "the Prometheus 0.0.4 text type")
+        failures += 1
+    code, om_body, om_ctype = _get(
+        endpoint.port, "/metrics",
+        accept="application/openmetrics-text; version=1.0.0")
+    if code != 200 or not om_ctype.startswith(
+            "application/openmetrics-text"):
+        print(f"FAIL: OpenMetrics Accept negotiated {code}/{om_ctype!r}")
+        failures += 1
+    if om_body and om_body.splitlines()[-1].strip() != "# EOF":
+        print("FAIL: OpenMetrics body is not '# EOF'-terminated")
+        failures += 1
 
     asyncio.run(server.close())
     if failures:

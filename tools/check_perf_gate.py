@@ -74,6 +74,23 @@ Eight checks, all against the recorded floor in tools/perf_floor.json:
    accepting a generation is nearly free. Graceful skip when no
    continual bench ran.
 
+9.  **Stream overhead** — streamed-vs-resident slowdown ceiling and
+    upload/compute overlap floor over the latest ``stream`` bench
+    record (check_stream_overhead). Graceful skip when absent.
+
+10. **Cold start** — warm-start compile reduction, program-acquisition
+    ratchet ceiling, and the serialized-artifact restore sub-checks
+    over the latest ``coldstart`` bench record (check_coldstart).
+    Graceful skip when absent.
+
+11. **Device-time roofline** — over the latest bench record carrying a
+    ``roofline`` summary (obs/profile.py window folded into bench.py's
+    JSON line): the attributed-device-seconds coverage of the profile
+    window's wall time must land inside the floor-configured band, and
+    the best per-tag utilization vs the hostenv.platform_peaks row
+    must clear the RATCHETING ``min_utilization`` floor. Graceful skip
+    when no profiled bench ran or the record is unattributable.
+
 Exit 0 = gate passed; exit 1 = regression, with one line per failure.
 Wired into the quick verification tier via tests/test_perf_gate.py.
 
@@ -659,6 +676,80 @@ def check_coldstart(floor, failures, candidate_path=None):
               f"/ {int(cs.get('restore_aot_loads', 0))} load(s)")
 
 
+def check_profile_roofline(floor, failures, candidate_path=None):
+    """Device-time attribution + roofline (check 11): over the latest
+    bench record carrying a ``roofline`` summary (the obs/profile.py
+    post-loop window bench.py folds into its JSON line):
+
+    - coverage — attributed device seconds over the profile window's
+      wall time — must land inside the floor-configured band. Too low
+      means the instrumented program boundaries are no longer where the
+      time goes (an untagged hot program appeared); above the ceiling
+      means double-counted or mis-rebased slices.
+    - the best per-tag utilization (achieved bytes/s or flops/s over
+      the hostenv.platform_peaks row) must clear the RATCHETING
+      ``min_utilization`` floor — raise it as the kernels improve.
+    - the same record must carry non-empty ``device_seconds_by_tag``.
+
+    No profiled bench recorded => the check reports itself skipped;
+    records without a cost-analysis join skip the utilization sub-check
+    (the backend exposes no bytes/flops there)."""
+    cfg = floor.get("profile")
+    if not cfg:
+        print("# no profile floor recorded; roofline check skipped")
+        return
+    recs = _load_keyed_records("roofline", candidate_path)
+    if not recs:
+        print("# no profiled bench recorded; roofline check skipped")
+        return
+    tag, rec = recs[-1]
+    rl = rec["roofline"]
+    by_tag = rl.get("by_tag") or {}
+    if not by_tag or not rec.get("device_seconds_by_tag"):
+        print(f"# profile[{tag}]: no attributed device seconds; "
+              "roofline check skipped")
+        return
+    n_fail0 = len(failures)
+    coverage = rl.get("coverage")
+    min_cov = float(cfg.get("min_coverage", 0.2))
+    max_cov = float(cfg.get("max_coverage", 1.5))
+    if coverage is None:
+        print(f"# profile[{tag}]: no coverage recorded; coverage band "
+              "sub-check skipped")
+    elif not (min_cov <= float(coverage) <= max_cov):
+        failures.append(
+            f"{tag}: device-time coverage {float(coverage):.2%} of the "
+            f"profile window is outside the [{min_cov:.0%}, "
+            f"{max_cov:.0%}] band — attribution is missing hot "
+            "programs or double-counting slices")
+    with_util = [r for r in by_tag.values()
+                 if "bytes_utilization" in r or "flops_utilization" in r]
+    if with_util:
+        best_util = max(
+            max(float(r.get("bytes_utilization", 0.0) or 0.0),
+                float(r.get("flops_utilization", 0.0) or 0.0))
+            for r in with_util)
+        min_util = float(cfg.get("min_utilization", 0.0))
+        if best_util < min_util:
+            failures.append(
+                f"{tag}: best roofline utilization {best_util:.2e} is "
+                f"under the {min_util:.0e} ratchet floor — the "
+                "attributed programs are not moving bytes/flops at a "
+                "credible rate for this platform")
+    else:
+        best_util = 0.0
+        print(f"# profile[{tag}]: no cost-analysis join (backend "
+              "exposes no bytes/flops); utilization sub-check skipped")
+    if len(failures) == n_fail0:
+        cov_s = ("n/a" if coverage is None
+                 else f"{float(coverage):.2%}")
+        verdicts = {t: r.get("verdict", "?") for t, r in
+                    sorted(by_tag.items())}
+        print(f"# profile[{tag}]: coverage {cov_s} (band "
+              f"[{min_cov:.0%}, {max_cov:.0%}]), best utilization "
+              f"{best_util:.2e}, {len(by_tag)} tag(s) {verdicts}")
+
+
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
@@ -717,6 +808,7 @@ def main(argv=None) -> int:
     check_continual_overhead(floor, failures, candidate)
     check_stream_overhead(floor, failures, candidate)
     check_coldstart(floor, failures, candidate)
+    check_profile_roofline(floor, failures, candidate)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")

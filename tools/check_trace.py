@@ -28,7 +28,13 @@ Checks, in order:
      ``args.trace_ids`` list, each listed trace_id resolves to a
      request span in the same trace, and each batched request's
      ``args.batch_id`` resolves to a batch span — so a coalesced batch
-     shows exactly which requests it carried.
+     shows exactly which requests it carried;
+  8. device-lane metadata (obs/profile.py slices merged by
+     ``chrome_events``): every complete span on a pid whose
+     ``process_name`` contains ``device`` must carry a non-empty
+     string ``args.tag`` and an ``args.source`` of ``fallback`` or
+     ``profiler`` — the lane is an attribution overlay, and an
+     unlabeled slice cannot be joined back to its program tag.
 
 Usage:  python tools/check_trace.py TRACE.json
 Exit 0 when the trace is valid; 1 with a diagnostic otherwise — so a
@@ -66,6 +72,14 @@ def check_trace(path: str) -> Tuple[bool, str]:
 
     _META_PAYLOAD = {"process_name": "name", "thread_name": "name",
                      "process_labels": "labels"}
+    # device-lane pids up front (the lane's metadata precedes its spans
+    # in our exporter, but a hand-edited trace may reorder them)
+    device_pids = {ev.get("pid") for ev in events
+                   if isinstance(ev, dict) and ev.get("ph") == "M"
+                   and ev.get("name") == "process_name"
+                   and isinstance(ev.get("args"), dict)
+                   and "device" in str(ev["args"].get("name", "")).lower()}
+    n_device = 0
     last_ts = {}  # (pid, tid) -> ts
     named_pids, named_tracks = set(), set()  # from metadata events
     n_complete = n_meta = 0
@@ -94,6 +108,18 @@ def check_trace(path: str) -> Tuple[bool, str]:
         if ph != "X":
             continue  # metadata/counter events need no ts ordering
         n_complete += 1
+        if ev.get("pid") in device_pids:
+            n_device += 1
+            args = ev.get("args")
+            if not isinstance(args, dict) or \
+                    not isinstance(args.get("tag"), str) or \
+                    not args.get("tag"):
+                return False, (f"device-lane event {i} ({name!r}) lacks "
+                               f"a non-empty string args.tag")
+            if args.get("source") not in ("fallback", "profiler"):
+                return False, (f"device-lane event {i} ({name!r}) has "
+                               f"args.source={args.get('source')!r}, not "
+                               f"fallback/profiler")
         if name == "serve/request":
             args = ev.get("args")
             if not isinstance(args, dict):
@@ -155,6 +181,8 @@ def check_trace(path: str) -> Tuple[bool, str]:
             return False, (f"serve/request {tid_} references batch_id "
                            f"{bid!r} with no matching serve/batch span")
     extra = (f", {len(req_ids)} linked request span(s)" if req_ids else "")
+    if n_device:
+        extra += f", {n_device} device-lane slice(s)"
     return True, (f"ok: {n_complete} complete spans on {len(last_ts)} "
                   f"track(s), {n_meta} metadata event(s){extra}")
 
