@@ -20,6 +20,13 @@ bench ensemble; `vs_baseline` is the speedup over dispatching the SAME
 request list sequentially straight into the engine — the no-scheduler
 alternative, measured in the same run.
 
+`--fleet` emits metric `fleet_availability` plus a `fleet` summary
+dict (perf-gate check 12): the --serve open-loop trace replays through
+a 3-replica FleetRouter (serve/fleet.py) with one replica killed
+mid-run; availability is the fraction of requests served despite the
+kill (failover retries absorb the dead replica), alongside the fleet
+p99 vs a single-replica reference measured in the same run.
+
 Baseline: the reference CPU result on Higgs-10.5M — 500 iterations in
 130.094 s => 3.843 iters/sec (docs/Experiments.rst:113; see BASELINE.md).
 Config mirrors the reference GPU benchmark setup (max_bin=63,
@@ -58,7 +65,7 @@ RELAY_PORTS = (8082, 8083, 8087)
 
 
 _BENCH_MODES = ("train", "predict", "serve", "continual", "stream",
-                "coldstart")
+                "coldstart", "fleet")
 
 
 def parse_bench_mode(argv=None, environ=None) -> str:
@@ -181,7 +188,8 @@ def _replay_child_stderr(path: str) -> None:
 
 _MODE_DEFAULT_ROWS = {"train": 10_500_000, "predict": 8_000_000,
                       "serve": 2_000_000, "continual": 2_000_000,
-                      "stream": 10_500_000, "coldstart": 20_000}
+                      "stream": 10_500_000, "coldstart": 20_000,
+                      "fleet": 500_000}
 # CPU-fallback shard sizes: the 1-core host must finish in budget (see
 # the fallback comment below); inference modes keep more rows than
 # training, and --serve pays per-request scheduling on top of traversal.
@@ -189,13 +197,14 @@ _MODE_DEFAULT_ROWS = {"train": 10_500_000, "predict": 8_000_000,
 # be big enough that cold compile dominates, so CPU keeps the default.
 _MODE_CPU_ROWS = {"train": 50_000, "predict": 300_000, "serve": 150_000,
                   "continual": 40_000, "stream": 50_000,
-                  "coldstart": 20_000}
+                  "coldstart": 20_000, "fleet": 60_000}
 _MODE_METRIC = {"train": "boosting_iters_per_sec_higgs_shape",
                 "predict": "predict_rows_per_sec",
                 "serve": "serve_rows_per_sec",
                 "continual": "continual_rows_per_sec",
                 "stream": "stream_rows_per_sec",
-                "coldstart": "coldstart_compile_reduction"}
+                "coldstart": "coldstart_compile_reduction",
+                "fleet": "fleet_availability"}
 
 
 def main():
@@ -787,6 +796,185 @@ def _measure_serve():
              lat["p50_ms"], lat["p99_ms"], bit_equal), file=sys.stderr)
 
 
+def _measure_fleet():
+    """Fleet chaos bench (serve/fleet.py): the --serve open-loop trace
+    fronted by an N-replica FleetRouter with one replica KILLED mid-run.
+    Emits `fleet_availability` (fraction of requests served despite the
+    kill — failover retries absorb the dead replica; perf-gate check 12
+    holds it >= 0.999) plus the fleet p50/p99 against a single-replica
+    reference replayed in the same run, the failover/quarantine
+    counters, and a served-vs-direct bit-parity verdict."""
+    import asyncio
+
+    n = int(os.environ.get("BENCH_ROWS", 500_000))
+    t = int(os.environ.get("BENCH_PREDICT_TREES", 100))
+    leaves = int(os.environ.get("BENCH_PREDICT_LEAVES", 255))
+    f = 28
+    n_replicas = int(os.environ.get("BENCH_FLEET_REPLICAS", 3))
+    max_batch = int(os.environ.get("BENCH_SERVE_MAX_BATCH", 8192))
+    max_wait_ms = float(os.environ.get("BENCH_SERVE_MAX_WAIT_MS", 2.0))
+
+    import jax
+    from lightgbm_tpu.compile_cache import configure as _cache_configure
+    _cache_configure("auto")
+    from lightgbm_tpu.model_io import LoadedModel
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.serve import (InProcessReplica, FleetRouter,
+                                    ModelRegistry, ModelServer, replay)
+
+    platform = jax.default_backend()
+    rng = np.random.RandomState(0)
+    trees = _random_trees(rng, t, leaves, f)
+
+    def make_replica(i: int) -> InProcessReplica:
+        # each replica packs its own registry from the SAME trees —
+        # the bit-identical-pack contract the failover math rests on
+        model = LoadedModel()
+        model.trees = trees
+        model.num_tree_per_iteration = 1
+        model.objective_str = "binary sigmoid:1"
+        model.max_feature_idx = f - 1
+        registry = ModelRegistry()
+        registry.load("bench", model=model)
+        return InProcessReplica(f"r{i}", ModelServer(
+            registry, max_batch_rows=max_batch, max_wait_ms=max_wait_ms))
+
+    replicas = [make_replica(i) for i in range(n_replicas)]
+    fleet = FleetRouter(replicas, probe_interval_ms=10.0,
+                        breaker_reset_s=0.25).start()
+    data = rng.randn(n, f)
+    sizes = _serve_request_sizes(rng, n)
+    bounds = np.concatenate([[0], np.cumsum(sizes)])
+    for rep in replicas:
+        # the process-wide compile cache makes replicas 1..N-1 warm
+        # from replica 0's compiles
+        rep.server.warm("bench", f)
+    ref_model = replicas[0].server.registry.get("bench").model
+
+    # single-replica reference: the same trace shape straight through
+    # one ModelServer (what --serve measures), for the p99 comparison
+    half = max(len(sizes) // 2, 1)
+    global_metrics.reset_latency("serve/request")
+    t0 = time.time()
+    asyncio.run(replay(replicas[0].server, "bench",
+                       data[:bounds[half]], sizes[:half], raw_score=True))
+    single_rps = float(bounds[half]) / (time.time() - t0)
+    single_lat = global_metrics.latency_summary("serve/request")
+
+    # fleet phase: open-loop Poisson arrivals at 70% of the measured
+    # single-replica capacity; replica 0 dies at the 40% mark
+    offered_rps = float(os.environ.get("BENCH_SERVE_LOAD", 0.7)) \
+        * single_rps
+    gaps = rng.exponential(np.asarray(sizes, np.float64) / offered_rps)
+    arrivals = np.concatenate([[0.0], np.cumsum(gaps)[:-1]])
+    kill_idx = max(int(0.4 * len(sizes)), 1)
+    lat_all: list = []
+    lat_post_kill: list = []
+    state = {"failed": 0, "kill_t": None}
+
+    async def one(i: int) -> None:
+        if arrivals[i] > 0:
+            await asyncio.sleep(float(arrivals[i]))
+        if i == kill_idx:
+            replicas[0].fail_dispatch = True  # SIGKILL stand-in
+            state["kill_t"] = time.perf_counter()
+        t_req = time.perf_counter()
+        try:
+            await fleet.predict("bench", data[bounds[i]:bounds[i + 1]],
+                                raw_score=True)
+        except Exception:
+            state["failed"] += 1
+            return
+        dt = time.perf_counter() - t_req
+        lat_all.append(dt)
+        if state["kill_t"] is not None and \
+                t_req >= state["kill_t"]:
+            lat_post_kill.append(dt)
+
+    async def fleet_phase() -> None:
+        await asyncio.gather(*[one(i) for i in range(len(sizes))])
+
+    t0 = time.time()
+    asyncio.run(fleet_phase())
+    fleet_wall = time.time() - t0
+
+    # bit parity: fleet answers (now riding the survivors) vs direct
+    async def probe() -> bool:
+        idx = list(range(min(4, len(sizes))))
+        outs = await asyncio.gather(*[
+            fleet.predict("bench", data[bounds[i]:bounds[i + 1]],
+                          raw_score=True) for i in idx])
+        return all(np.array_equal(
+            out, ref_model.predict(data[bounds[i]:bounds[i + 1]],
+                                   raw_score=True))
+            for i, out in zip(idx, outs))
+
+    bit_equal = asyncio.run(probe())
+    fstats = fleet.stats()
+    counters = fstats["counters"]
+
+    async def teardown() -> None:
+        fleet.stop()
+        for rep in replicas:
+            await rep.server.close()
+
+    asyncio.run(teardown())
+
+    served = len(lat_all)
+    total = served + state["failed"]
+    availability = served / max(total, 1)
+    q = (lambda a, p: float(np.percentile(np.asarray(a) * 1e3, p))
+         if a else 0.0)
+    fleet_summary = {
+        "availability": round(availability, 6),
+        "requests": total,
+        "served": served,
+        "failed": state["failed"],
+        "replicas": n_replicas,
+        "failovers": int(counters.get("fleet/failovers", 0)),
+        "quarantines": int(counters.get("fleet/quarantines", 0)),
+        "killed_quarantined": bool(
+            fstats["replicas"]["r0"]["quarantined"]),
+        "p50_ms": round(q(lat_all, 50), 3),
+        "p99_ms": round(q(lat_all, 99), 3),
+        "failover_p99_ms": round(q(lat_post_kill, 99), 3),
+        "single_p50_ms": single_lat["p50_ms"],
+        "single_p99_ms": single_lat["p99_ms"],
+        "single_rows_per_sec": round(single_rps, 1),
+        "rows_per_sec": round(float(bounds[-1]) / max(fleet_wall, 1e-9),
+                              1),
+        "parity_ok": bool(bit_equal),
+    }
+    unit = ("fraction served (N=%d, T=%d, %d leaves, %d requests, "
+            "%d replicas, kill@40%%" % (n, t, leaves, total, n_replicas))
+    if platform != "tpu":
+        unit += ", platform=%s" % platform
+    if not bit_equal:
+        unit += ", PARITY-MISMATCH"
+    unit += ")"
+    result = {
+        "metric": "fleet_availability",
+        "value": round(availability, 6),
+        "unit": unit,
+        # the anchor IS the availability target: 1.0 = no request lost
+        "vs_baseline": round(availability, 6),
+        "fleet": fleet_summary,
+    }
+    out_path = os.environ.get("BENCH_OUT")
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(json.dumps(result) + "\n")
+    else:
+        print(json.dumps(result), flush=True)
+    print("# platform=%s availability=%.6f served=%d/%d failovers=%d "
+          "quarantines=%d fleet_p99=%.2fms single_p99=%.2fms "
+          "bit_equal=%s"
+          % (platform, availability, served, total,
+             fleet_summary["failovers"], fleet_summary["quarantines"],
+             fleet_summary["p99_ms"], fleet_summary["single_p99_ms"],
+             bit_equal), file=sys.stderr)
+
+
 def _measure_continual():
     """Continual-training bench (resilience/continual.py): BENCH_ROWS
     of Higgs-shaped data ingested in BENCH_CONTINUAL_GENERATIONS
@@ -1167,7 +1355,8 @@ def _measure_coldstart():
 
 
 _MODE_MEASURE = {"train": _measure, "predict": _measure_predict,
-                 "serve": _measure_serve, "continual": _measure_continual,
+                 "serve": _measure_serve, "fleet": _measure_fleet,
+                 "continual": _measure_continual,
                  "stream": _measure_stream, "coldstart": _measure_coldstart}
 
 

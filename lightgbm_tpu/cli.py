@@ -249,6 +249,10 @@ class Application:
             print(f"[LightGBM-TPU] served {stats['requests']} requests "
                   f"({stats['rows']} rows) in {stats['seconds']:.3f} s; "
                   f"predictions written to {cfg.output_result}")
+        if stats.get("drained"):
+            # SIGTERM drain: completed answers are on disk; exit with
+            # the preemption code so a supervisor re-runs the replica
+            raise SystemExit(int(stats["exit_code"]))
 
     # ------------------------------------------------------------------
     def _save_binary(self) -> None:

@@ -193,6 +193,7 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_checkpoint_every": ["checkpoint_every", "checkpoint_freq"],
     "tpu_checkpoint_path": ["checkpoint_path", "checkpoint_file"],
     "tpu_elastic_resume": ["elastic_resume"],
+    "tpu_watchdog_deadline_s": ["watchdog_deadline_s", "watchdog_deadline"],
     "tpu_continual_rounds": ["continual_rounds"],
     "tpu_continual_retain": ["continual_retain", "continual_snapshots"],
     "tpu_continual_eval_fraction": ["continual_eval_fraction"],
@@ -211,6 +212,10 @@ _ALIASES: Dict[str, List[str]] = {
     "serve_breaker_threshold": ["serve_breaker_failures"],
     "serve_breaker_reset_s": ["serve_breaker_reset"],
     "serve_artifact_dir": ["artifact_dir", "serve_artifacts_dir"],
+    # serving-fleet knobs (serve/fleet.py)
+    "serve_fleet_replicas": ["fleet_replicas"],
+    "serve_probe_interval_ms": ["fleet_probe_interval_ms"],
+    "serve_hedge_ms": ["fleet_hedge_ms"],
 }
 
 _ALIAS_TO_CANONICAL: Dict[str, str] = {}
@@ -672,6 +677,17 @@ class Config:
     # with ResumeMismatchError. Structural drift (objective, dataset
     # shape, tree counts) ALWAYS refuses.
     tpu_elastic_resume: bool = True
+    # distributed-training watchdog (resilience/watchdog.py). With
+    # tpu_watchdog_deadline_s > 0, engine.train runs a per-iteration
+    # heartbeat allgather (reusing the obs/health straggler machinery)
+    # bounded by this deadline: a peer that hangs mid-collective turns
+    # the infinite stall into a structured PeerLostError within the
+    # deadline, the flight recorder dumps a postmortem, a checkpoint is
+    # written (tpu_checkpoint_path set), and the process exits with
+    # code 75 (EXIT_PREEMPTED) so a supervisor restarts the survivors
+    # on a shrunk mesh through the elastic-resume path. 0 = watchdog
+    # off (single-host default — collectives can't be peer-hung).
+    tpu_watchdog_deadline_s: float = 0.0
     # continual training (resilience/continual.py; lgb.continual_train).
     # Each ingested chunk trains one GENERATION of tpu_continual_rounds
     # extra iterations onto the long-lived model ("extend" mode;
@@ -739,6 +755,20 @@ class Config:
     # a fresh compile with bit-identical predictions either way.
     # Empty = off.
     serve_artifact_dir: str = ""
+    # serving fleet (serve/fleet.py FleetRouter): N ModelServer
+    # replicas behind health-gated routing. serve_fleet_replicas sizes
+    # the fleet (task-level drivers and bench.py --fleet build this
+    # many in-process replicas; tools/check_fleet.py spawns them as
+    # subprocesses). serve_probe_interval_ms paces the /readyz +
+    # /healthz probe loop that drives the quarantine/reinstate state
+    # machine. serve_hedge_ms > 0 arms hedged dispatch: a request
+    # still unanswered after this many ms fires a duplicate on another
+    # healthy replica and the first answer wins (bit-identical by the
+    # pack contract, asserted) — a p99 tail cutter that costs duplicate
+    # work, so off (0) by default.
+    serve_fleet_replicas: int = 3
+    serve_probe_interval_ms: float = 50.0
+    serve_hedge_ms: float = 0.0
 
     # stash for unknown params (kept for forward-compat, like reference ignores)
     extra_params: Dict[str, Any] = dataclasses.field(default_factory=dict)

@@ -16,7 +16,7 @@ from .obs.flightrec import global_flightrec
 from .obs.health import HealthError
 from .resilience import checkpoint as ckpt_mod
 from .resilience import faults as faults_mod
-from .resilience.errors import EXIT_PREEMPTED
+from .resilience.errors import EXIT_PREEMPTED, PeerLostError
 
 
 def train(params: Dict[str, Any], train_set: Dataset,
@@ -106,6 +106,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
     preempt = {"flag": False}
     prev_sigterm = _install_sigterm(preempt) if ckpt_path else None
 
+    # distributed-training watchdog (resilience/watchdog.py): with
+    # tpu_watchdog_deadline_s set, every iteration boundary runs a
+    # deadline-bounded heartbeat; a hung peer becomes PeerLostError ->
+    # checkpoint + exit(EXIT_PREEMPTED) instead of an infinite stall
+    from .resilience import watchdog as watchdog_mod
+    watchdog = watchdog_mod.from_config(cfg)
+
     interrupted = False
     try:
         for i in range(start_iteration, num_boost_round):
@@ -181,6 +188,36 @@ def train(params: Dict[str, Any], train_set: Dataset,
                     "iterations")
                 break
 
+            # -- iteration boundary: peer-liveness heartbeat. Outside
+            # the inner try on purpose: its SystemExit escalation must
+            # not be swallowed by the interrupt-safety handler above.
+            if watchdog is not None:
+                try:
+                    watchdog.beat(i)
+                except PeerLostError as exc:
+                    from . import log
+                    if ckpt_path:
+                        ckpt_mod.save_checkpoint(booster, ckpt_path,
+                                                 num_boost_round,
+                                                 finished=False)
+                        if global_flightrec.armed:
+                            global_flightrec.record("checkpoint",
+                                                    iteration=i + 1,
+                                                    path=ckpt_path)
+                    log.warning(
+                        f"peer lost at iteration {i} ({exc}); "
+                        + (f"snapshot written to {ckpt_path}; "
+                           if ckpt_path else "")
+                        + f"exiting with code {EXIT_PREEMPTED} for "
+                        "elastic resume on the surviving mesh")
+                    if global_flightrec.armed:
+                        global_flightrec.record(
+                            "peer_lost", iteration=i,
+                            deadline_s=exc.deadline_s,
+                            exit_code=EXIT_PREEMPTED)
+                    _flush_obs_egress(reason="peer_lost")
+                    raise SystemExit(EXIT_PREEMPTED)
+
             # -- iteration boundary: durable snapshot / preemption exit
             if ckpt_path:
                 if faults.armed and faults.kill_now(i):
@@ -228,6 +265,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             _flush_obs_egress(reason="preempt")
             raise SystemExit(EXIT_PREEMPTED)
     finally:
+        if watchdog is not None:
+            watchdog.close()
         if prev_sigterm is not None:
             try:
                 signal.signal(signal.SIGTERM, prev_sigterm)

@@ -240,6 +240,28 @@ def render_openmetrics(registry=None,
         doc.sample("lgbmtpu_continual_mesh_resizes_total", "counter",
                    ct.get("mesh_resizes", 0))
 
+    # serving-fleet health (serve/fleet.py FleetRouter; the
+    # failover/hedge/quarantine COUNTS ride the generic fleet/*
+    # counters above — these are the per-replica state gauges the
+    # chaos validator scrapes to see the kill and the recovery)
+    fl = meta.get("fleet")
+    if isinstance(fl, dict) and "replicas" in fl:
+        doc.sample("lgbmtpu_fleet_replicas", "gauge", fl["replicas"],
+                   help_text="configured replicas behind the "
+                             "FleetRouter")
+        for name in sorted(fl.get("replica_up", {})):
+            doc.sample("lgbmtpu_fleet_replica_up", "gauge",
+                       fl["replica_up"][name],
+                       labels={"replica": name},
+                       help_text="1 while the replica answers its "
+                                 "liveness probe")
+        for name in sorted(fl.get("replica_quarantined", {})):
+            doc.sample("lgbmtpu_fleet_replica_quarantined", "gauge",
+                       fl["replica_quarantined"][name],
+                       labels={"replica": name},
+                       help_text="1 while the router holds the replica "
+                                 "out of rotation")
+
     # out-of-core streaming accounting (io/streaming.py StreamStats,
     # published per iteration by the streamed boosting paths): the
     # driver-visible proof that slab uploads overlap the histogram

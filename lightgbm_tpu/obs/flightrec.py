@@ -11,7 +11,15 @@ disabled path costs one branch):
   ``sigterm`` transitions and ``health_anomaly`` on a propagating
   DriftError/NonFiniteError (the anomaly triggers an immediate dump);
 * ``serve/server.py`` — per-request outcomes including degradation
-  errors (load shed, deadline, circuit open);
+  errors (load shed, deadline, circuit open) and the SIGTERM drain
+  (``serve_drain`` / ``serve_drained``);
+* ``serve/fleet.py`` — replica quarantine/reinstate transitions,
+  failovers, hedges, parity violations, and fleet drain events — a
+  fleet postmortem names which replica died and when the router
+  noticed;
+* ``resilience/watchdog.py`` — ``watchdog_heartbeat_miss`` (with an
+  immediate postmortem dump) when a heartbeat collective blows its
+  deadline; engine.train adds the ``peer_lost`` escalation event;
 * ``resilience/faults.py`` — every injected fault.
 
 Arming: ``LGBM_TPU_FLIGHTREC=/path/dump.json`` (dump target; a bare

@@ -53,13 +53,23 @@ def init_distributed(coordinator_address: Optional[str] = None,
                      process_id: Optional[int] = None,
                      machines=None,
                      local_device_ids=None,
-                     initialization_timeout: Optional[float] = None) -> None:
+                     initialization_timeout: Optional[float] = None,
+                     connect_retries: int = 4,
+                     connect_backoff_s: float = 0.5) -> None:
     """Join this process into the global JAX runtime.
 
     Either pass `coordinator_address`/`num_processes`/`process_id`
     directly, or a reference-style `machines` list (first entry is the
     coordinator; `process_id` falls back to the LGBM_TPU_RANK env var).
-    Idempotent per process.
+    Idempotent per process — a second call (even through a different
+    layer that already ran ``jax.distributed.initialize``) is a no-op.
+
+    A coordinator that is still coming up is the common fleet-restart
+    race (every worker execs at once; rank 0's service binds last), so
+    the connection is retried ``connect_retries`` times with exponential
+    backoff before giving up with a structured
+    :class:`~lightgbm_tpu.resilience.errors.DistributedInitError` that a
+    supervisor can match on without string-parsing a JAX traceback.
     """
     global _initialized
     if _initialized:
@@ -83,12 +93,47 @@ def init_distributed(coordinator_address: Optional[str] = None,
     kwargs = {}
     if initialization_timeout is not None:
         kwargs["initialization_timeout"] = int(initialization_timeout)
-    jax.distributed.initialize(
-        coordinator_address=coordinator_address,
-        num_processes=num_processes,
-        process_id=process_id,
-        local_device_ids=local_device_ids,
-        **kwargs)
+
+    from ..resilience.degrade import backoff_delays
+    from ..resilience.errors import DistributedInitError
+
+    attempts = max(1, int(connect_retries) + 1)
+    delays = backoff_delays(attempts - 1, float(connect_backoff_s),
+                            cap_s=10.0)
+    last_error: Optional[BaseException] = None
+    for attempt in range(attempts):
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+                local_device_ids=local_device_ids,
+                **kwargs)
+            break
+        except RuntimeError as exc:
+            # a prior direct jax.distributed.initialize() by the caller
+            # (or a framework above us) — adopt it, don't fight it
+            if "already initialized" in str(exc).lower():
+                log.info("distributed runtime was already initialized; "
+                         "adopting the existing client")
+                break
+            last_error = exc
+        except (ValueError, TypeError):
+            raise  # misconfiguration, retrying cannot fix it
+        except Exception as exc:  # connect/handshake faults
+            last_error = exc
+        if attempt < attempts - 1:
+            delay = delays[attempt]
+            log.warning(
+                f"distributed init attempt {attempt + 1}/{attempts} "
+                f"failed ({last_error}); retrying in {delay:.2f}s")
+            import time
+            time.sleep(delay)
+    else:
+        raise DistributedInitError(
+            f"could not join the distributed runtime at "
+            f"{coordinator_address!r} after {attempts} attempts: "
+            f"{last_error}", attempts=attempts, last_error=last_error)
     _initialized = True
     log.info(f"distributed runtime up: process {process_id}/"
              f"{num_processes}, {len(jax.devices())} global devices "

@@ -101,3 +101,35 @@ class TransientServeError(RuntimeError):
     injected fault-plan failure). The server's dispatch retries these
     with exponential backoff; anything else counts against the circuit
     breaker immediately."""
+
+
+class DistributedInitError(RuntimeError):
+    """Joining the jax.distributed runtime failed after the configured
+    retry schedule (coordinator down, wrong address, handshake
+    timeout). ``attempts`` is how many connection attempts were made;
+    ``last_error`` carries the final underlying failure so a supervisor
+    can distinguish a dead coordinator from a misconfigured rank."""
+
+    def __init__(self, message: str, attempts: int = 1,
+                 last_error: Optional[BaseException] = None):
+        self.attempts = int(attempts)
+        self.last_error = last_error
+        super().__init__(message)
+
+
+class PeerLostError(RuntimeError):
+    """The training watchdog's heartbeat collective did not complete
+    within ``tpu_watchdog_deadline_s`` — a peer process is hung or dead
+    and every further collective would stall with it. ``deadline_s`` is
+    the deadline that expired, ``iteration`` the boundary at which the
+    heartbeat was attempted, and ``phase`` names the watched step.
+    engine.train escalates this to checkpoint + ``EXIT_PREEMPTED`` so a
+    supervisor restarts the survivors on a shrunk mesh (elastic
+    resume)."""
+
+    def __init__(self, message: str, deadline_s: float = 0.0,
+                 iteration: Optional[int] = None, phase: str = "heartbeat"):
+        self.deadline_s = float(deadline_s)
+        self.iteration = iteration
+        self.phase = str(phase)
+        super().__init__(message)

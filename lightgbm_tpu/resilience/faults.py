@@ -29,6 +29,12 @@ the REAL production code paths — no monkeypatched shortcuts:
   retry/backoff path and, once retries exhaust, the circuit breaker).
 - ``serve_slow_ms=m`` — each serve dispatch sleeps m ms on the executor
   (deterministic queue pressure for the deadline / load-shed tests).
+- ``hang_peer_at_iter=k`` (optionally ``hang_peer_s=s``) — the heartbeat
+  worker of ``resilience/watchdog.py`` stalls for s seconds (default
+  30) at iteration k, simulating a peer hung mid-collective; the
+  watchdog deadline must convert the stall into ``PeerLostError``
+  instead of waiting it out. The sleep runs on the watchdog's daemon
+  thread, so an escalating process still exits cleanly.
 
 Plans parse from the ``LGBM_TPU_FAULTS`` env var (comma-separated
 ``key=value``) or install programmatically via ``install(plan)``.
@@ -47,8 +53,8 @@ from .errors import TransientServeError
 
 _INT_KEYS = {"kill_at_iter", "resize_at_iter", "corrupt_checkpoint_byte",
              "poison_labels_at_iter", "registry_load_failures",
-             "serve_predict_failures", "slow_shard"}
-_FLOAT_KEYS = {"slow_iter_ms", "serve_slow_ms"}
+             "serve_predict_failures", "slow_shard", "hang_peer_at_iter"}
+_FLOAT_KEYS = {"slow_iter_ms", "serve_slow_ms", "hang_peer_s"}
 
 
 class FaultPlan:
@@ -65,6 +71,8 @@ class FaultPlan:
         self.registry_load_failures: int = 0
         self.serve_predict_failures: int = 0
         self.serve_slow_ms: float = 0.0
+        self.hang_peer_at_iter: Optional[int] = None
+        self.hang_peer_s: float = 30.0
         for key, value in kwargs.items():
             if not hasattr(self, key):
                 raise ValueError(f"unknown fault knob {key!r}")
@@ -203,6 +211,18 @@ class FaultPlan:
             raise TransientServeError(
                 f"injected predict failure for model {name!r}")
 
+    def maybe_hang_peer(self, iteration: int) -> None:
+        """Stall the watchdog heartbeat at iteration `iteration` as if a
+        peer hung mid-collective. Called from the watchdog's daemon
+        heartbeat thread, never the main thread — the main thread's
+        deadline keeps ticking and must fire while this sleeps."""
+        if self.hang_peer_at_iter is None or \
+                iteration != self.hang_peer_at_iter:
+            return
+        self.hang_peer_at_iter = None  # one shot
+        self._note("hang_peer")
+        time.sleep(max(0.0, self.hang_peer_s))
+
 
 class _NoFaults:
     """The disabled plan: armed=False, every hook a no-op."""
@@ -225,6 +245,9 @@ class _NoFaults:
         pass
 
     def check_serve_dispatch(self, name: str) -> None:
+        pass
+
+    def maybe_hang_peer(self, iteration: int) -> None:
         pass
 
 

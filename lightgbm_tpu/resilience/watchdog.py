@@ -1,0 +1,175 @@
+"""Distributed-training watchdog: a deadline on peer liveness.
+
+Multi-process training has one failure mode no local try/except can
+see: a peer host hangs (kernel wedge, preempted VM, dead NIC) and every
+subsequent collective stalls with it — forever, because XLA collectives
+have no timeout. The watchdog turns that infinite stall into a bounded,
+structured failure:
+
+- every iteration boundary, :meth:`Watchdog.beat` runs a tiny heartbeat
+  allgather (the obs/health straggler plumbing: `process_allgather` of a
+  few floats) on a **daemon worker thread**;
+- the main thread waits at most ``tpu_watchdog_deadline_s``; if the
+  collective has not completed by then, a peer is hung or dead, and the
+  beat raises :class:`~.errors.PeerLostError` instead of joining the
+  stall;
+- engine.train escalates: flight-recorder postmortem, checkpoint,
+  ``SystemExit(EXIT_PREEMPTED)`` — the same exit-75 contract as a
+  SIGTERM preemption, so a supervisor re-runs the survivors and
+  PR-9's elastic resume restores onto the shrunk mesh.
+
+The heartbeat payload carries each host's previous beat round-trip
+time, so a completed beat doubles as a straggler probe: the gathered
+RTT matrix goes through ``HealthRegistry.straggler_from_matrix`` and a
+peer that is slowing down is visible in the skew stats before it is
+declared lost. Single-process runs keep the full deadline machinery
+(the chaos tests drive it with the ``hang_peer_at_iter`` fault) — the
+heartbeat just has no peers to gather from.
+
+Worker threads are daemons on purpose: a beat that never completes must
+not keep the escalating process alive.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .errors import PeerLostError
+
+
+class Watchdog:
+    """Per-iteration heartbeat with a hard deadline.
+
+    One instance per training run. Not thread-safe across concurrent
+    ``beat`` calls (the training loop is the only caller); internal
+    state shared with worker threads is published via the per-beat
+    result dict under the beat lock.
+    """
+
+    def __init__(self, deadline_s: float, name: str = "train"):
+        self.deadline_s = float(deadline_s)
+        self.name = str(name)
+        self.beats = 0
+        self.misses = 0
+        self.last_rtt_s = 0.0
+        self.worst_rtt_s = 0.0
+        self.last_skew: Optional[Dict[str, Any]] = None
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    def _heartbeat(self, iteration: int, out: Dict[str, Any]) -> None:
+        """The watched work. Runs on a daemon worker thread so a hang
+        here (real peer loss, or the injected ``hang_peer_at_iter``
+        fault) stalls the worker, never the training loop."""
+        from .faults import global_faults
+        if global_faults.armed:
+            global_faults.maybe_hang_peer(iteration)
+        t0 = time.perf_counter()
+        try:
+            import jax
+            if jax.process_count() > 1:
+                import numpy as np
+                from jax.experimental import multihost_utils as mh
+                # payload: [rank, iteration, previous beat's rtt]. The
+                # gather itself is the liveness proof; the rtt column
+                # feeds the straggler stats so a slowing peer shows up
+                # before it is declared lost.
+                payload = np.asarray(
+                    [float(jax.process_index()), float(iteration),
+                     float(self.last_rtt_s)], np.float64)
+                gathered = np.asarray(mh.process_allgather(payload))
+                out["n_peers"] = int(gathered.shape[0])
+                iters = gathered[:, 1]
+                if float(iters.min()) != float(iters.max()):
+                    out["desync"] = {"min_iter": int(iters.min()),
+                                     "max_iter": int(iters.max())}
+                if self.beats > 1:  # first beat has no prior rtt
+                    from ..obs.health import HealthRegistry
+                    out["skew"] = HealthRegistry.straggler_from_matrix(
+                        ["heartbeat"], gathered[:, 2:3])
+            else:
+                out["n_peers"] = 1
+        except Exception as exc:
+            # a gather that ERRORS (vs hangs) is still a completed beat:
+            # the runtime answered. Note it; the deadline machinery is
+            # for silence, not for loud failures.
+            out["error"] = f"{type(exc).__name__}: {exc}"
+        out["rtt_s"] = time.perf_counter() - t0
+        out["ok"] = True
+
+    # ------------------------------------------------------------------
+    def beat(self, iteration: int) -> Dict[str, Any]:
+        """Run one heartbeat; raise :class:`PeerLostError` if it does
+        not complete within ``deadline_s``. Returns the beat stats on
+        success ({"rtt_s": ..., "n_peers": ..., optional "skew"})."""
+        if self._closed:
+            return {"ok": False, "closed": True}
+        self.beats += 1
+        out: Dict[str, Any] = {}
+        done = threading.Event()
+
+        def _run() -> None:
+            try:
+                self._heartbeat(iteration, out)
+            finally:
+                done.set()
+
+        worker = threading.Thread(
+            target=_run, name=f"lgbmtpu-watchdog-{self.name}", daemon=True)
+        worker.start()
+        if not done.wait(self.deadline_s):
+            self.misses += 1
+            self._escalate(iteration)
+        if "rtt_s" in out:
+            self.last_rtt_s = float(out["rtt_s"])
+            self.worst_rtt_s = max(self.worst_rtt_s, self.last_rtt_s)
+        if out.get("skew") is not None:
+            self.last_skew = out["skew"]
+        from ..obs.metrics import global_metrics
+        global_metrics.inc_counter("resilience/watchdog_beats")
+        return out
+
+    def _escalate(self, iteration: int) -> None:
+        """Deadline expired: postmortem, then the structured error.
+        The hung worker thread is abandoned (daemon) — there is no safe
+        way to interrupt a thread stuck inside a collective."""
+        from ..obs.metrics import global_metrics
+        global_metrics.inc_counter("resilience/watchdog_beats")
+        global_metrics.inc_counter("resilience/watchdog_misses")
+        from ..obs.flightrec import global_flightrec
+        if global_flightrec.armed:
+            global_flightrec.record(
+                "watchdog_heartbeat_miss", iteration=iteration,
+                deadline_s=self.deadline_s, beats=self.beats,
+                misses=self.misses, last_rtt_s=self.last_rtt_s)
+            global_flightrec.maybe_dump(reason="watchdog_heartbeat_miss")
+        raise PeerLostError(
+            f"heartbeat collective did not complete within "
+            f"{self.deadline_s:g}s at iteration {iteration} — a peer "
+            f"process is hung or dead; escalating to checkpoint + "
+            f"preemption exit so the survivors elastic-resume",
+            deadline_s=self.deadline_s, iteration=iteration,
+            phase="heartbeat")
+
+    # ------------------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        return {"beats": self.beats, "misses": self.misses,
+                "deadline_s": self.deadline_s,
+                "last_rtt_s": round(self.last_rtt_s, 6),
+                "worst_rtt_s": round(self.worst_rtt_s, 6),
+                "skew": self.last_skew}
+
+    def close(self) -> None:
+        """Stop issuing beats. Hung workers (daemons) are abandoned."""
+        self._closed = True
+
+
+def from_config(cfg) -> Optional[Watchdog]:
+    """Build the training watchdog when ``tpu_watchdog_deadline_s`` is
+    set; None (no per-iteration overhead at all) otherwise."""
+    deadline = float(getattr(cfg, "tpu_watchdog_deadline_s", 0.0) or 0.0)
+    if deadline <= 0:
+        return None
+    return Watchdog(deadline)

@@ -21,17 +21,28 @@ small concurrent requests. This package turns one into the other:
   tracks per-request latency into ``obs.metrics`` p50/p95/p99
   reservoirs, and backs ``python -m lightgbm_tpu serve`` and
   ``bench.py --serve``.
+- ``fleet``     — the failure-domain layer: ``FleetRouter`` fronts N
+  replicas (in-process or subprocess) with health-gated routing,
+  quarantine/reinstate, failover retry of idempotent predicts, hedged
+  dispatch, and the SIGTERM drain / exit-75 contract.
 """
 
 from .artifacts import ArtifactStore, serialize_available  # noqa: F401
 from .registry import ModelRegistry, ServedModel  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
 from .lowlat import SERVE_LOWLAT_TAG, LowLatencyPredictor  # noqa: F401
-from .server import ModelServer, replay, serve_file  # noqa: F401
+from .server import (ModelServer, registry_from_config, replay,  # noqa: F401
+                     serve_file, server_from_config)
+from .fleet import (FleetRouter, HTTPReplica,  # noqa: F401
+                    InProcessReplica, aggregate_counter_totals,
+                    build_inprocess_fleet)
 
 __all__ = [
     "ArtifactStore", "serialize_available",
     "ModelRegistry", "ServedModel", "MicroBatcher",
     "LowLatencyPredictor", "SERVE_LOWLAT_TAG",
     "ModelServer", "replay", "serve_file",
+    "registry_from_config", "server_from_config",
+    "FleetRouter", "HTTPReplica", "InProcessReplica",
+    "aggregate_counter_totals", "build_inprocess_fleet",
 ]

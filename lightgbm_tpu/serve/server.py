@@ -111,6 +111,7 @@ class ModelServer:
             max_workers=1, thread_name_prefix="lgbm-serve")
         self._batchers: Dict[str, MicroBatcher] = {}
         self._warming = 0  # warm() calls in flight (readiness gate)
+        self._draining = False  # SIGTERM drain: no new admissions
         self._metrics_endpoint = None
 
     # ------------------------------------------------------------------
@@ -148,6 +149,15 @@ class ModelServer:
         the half-open probe succeeds. Every event lands in the
         ``resilience/*`` obs counters (``lgbmtpu_resilience_*``)."""
         t0 = time.perf_counter()
+        if self._draining:
+            # graceful-drain contract: a draining server sheds new
+            # arrivals BEFORE they cost anything — already-admitted
+            # requests keep running to completion (drain() waits on
+            # them), so nothing dies mid-batch
+            global_metrics.inc_counter("resilience/drain_rejected")
+            raise ServerOverloaded(
+                "server is draining (shutdown requested): not "
+                "admitting new requests", retry_after_s=0.0)
         deadline = (t0 + self.deadline_s) if self.deadline_s > 0 else 0.0
         x = np.asarray(data, np.float64)
         if x.ndim == 1:
@@ -354,9 +364,43 @@ class ModelServer:
 
     @property
     def ready(self) -> bool:
-        """Readiness: at least one model registered and no warm() in
-        flight. Liveness (``/healthz``) is just the listener being up."""
-        return self._warming == 0 and len(self.registry) > 0
+        """Readiness: at least one model registered, no warm() in
+        flight, and not draining (a draining replica deregisters itself
+        by flipping ``/readyz`` to 503 — the router stops routing to it
+        before the process exits). Liveness (``/healthz``) is just the
+        listener being up."""
+        return (not self._draining and self._warming == 0
+                and len(self.registry) > 0)
+
+    # ------------------------------------------------------------------
+    # graceful drain (SIGTERM contract, single-replica half of the
+    # fleet's drain: serve/fleet.py reuses begin_drain/drain per replica)
+    def begin_drain(self) -> None:
+        """Stop admitting new requests (idempotent). ``ready`` flips
+        false immediately so readiness-gated routers deregister."""
+        if self._draining:
+            return
+        self._draining = True
+        global_metrics.inc_counter("resilience/drain_begin")
+        if global_flightrec.armed:
+            global_flightrec.record("serve_drain",
+                                    queued_rows=self._queued_rows)
+
+    async def drain(self, timeout_s: float = 30.0) -> bool:
+        """Graceful drain: stop admitting, wait (bounded) for every
+        already-admitted request to complete, flush pending batches.
+        Returns True when the server emptied within the timeout."""
+        self.begin_drain()
+        deadline = time.perf_counter() + max(float(timeout_s), 0.0)
+        while self._queued_rows > 0 and time.perf_counter() < deadline:
+            for b in self._batchers.values():
+                b.flush()  # don't make stragglers wait out max_wait_ms
+            await asyncio.sleep(0.002)
+        drained = self._queued_rows == 0
+        if global_flightrec.armed:
+            global_flightrec.record("serve_drained", ok=drained,
+                                    queued_rows=self._queued_rows)
+        return drained
 
     def start_metrics_endpoint(self, port: int = 0,
                                host: Optional[str] = None):
@@ -411,19 +455,28 @@ class ModelServer:
 # ----------------------------------------------------------------------
 async def replay(server: ModelServer, name: str, data: np.ndarray,
                  sizes: Sequence[int], raw_score: bool = False,
-                 arrival_s: Optional[Sequence[float]] = None
-                 ) -> List[np.ndarray]:
+                 arrival_s: Optional[Sequence[float]] = None,
+                 drop_rejected: bool = False
+                 ) -> List[Optional[np.ndarray]]:
     """Fire one request per entry of `sizes`, slicing `data` in order,
     all concurrently; returns the per-request outputs in request order.
     With `arrival_s`, request i is released at that offset from the
     replay start (an OPEN-loop trace: arrivals don't wait for earlier
     completions — queueing delay shows up in the latency quantiles
-    instead of silently throttling the offered load)."""
-    async def one(lo: int, hi: int, delay: float) -> np.ndarray:
+    instead of silently throttling the offered load). With
+    `drop_rejected`, a request shed because the server started draining
+    resolves to None instead of failing the whole replay (serve_file's
+    SIGTERM path: completed answers still get written)."""
+    async def one(lo: int, hi: int, delay: float) -> Optional[np.ndarray]:
         if delay > 0:
             await asyncio.sleep(delay)
-        return await server.predict(name, data[lo:hi],
-                                    raw_score=raw_score)
+        try:
+            return await server.predict(name, data[lo:hi],
+                                        raw_score=raw_score)
+        except ServerOverloaded:
+            if drop_rejected and server._draining:
+                return None
+            raise
 
     tasks = []
     lo = 0
@@ -452,24 +505,56 @@ def request_sizes(total_rows: int, request_rows: int = 0) -> List[int]:
     return sizes
 
 
+def registry_from_config(cfg) -> ModelRegistry:
+    """One registry, sized by the serve_* knobs — shared by the
+    single-server driver (serve_file) and each fleet replica
+    (serve/fleet.py), so every serving process packs models under the
+    identical contract (bit-identical outputs, PR-3)."""
+    return ModelRegistry(max_pack_bytes=cfg.serve_cache_bytes,
+                         lowlat_max_rows=cfg.serve_lowlat_max_rows,
+                         predict_chunk_rows=cfg.tpu_predict_chunk,
+                         artifact_dir=cfg.serve_artifact_dir,
+                         compile_cache=cfg.tpu_compile_cache)
+
+
+def server_from_config(registry: ModelRegistry, cfg) -> ModelServer:
+    """Build a ModelServer from the serve_* config knobs (the one
+    construction recipe for serve_file, fleet replicas, and tests)."""
+    return ModelServer(registry,
+                       max_batch_rows=cfg.serve_max_batch_rows,
+                       max_wait_ms=cfg.serve_max_wait_ms,
+                       deadline_ms=cfg.serve_deadline_ms,
+                       max_queue_rows=cfg.serve_max_queue_rows,
+                       retry_max=cfg.serve_retry_max,
+                       retry_backoff_ms=cfg.serve_retry_backoff_ms,
+                       breaker_threshold=cfg.serve_breaker_threshold,
+                       breaker_reset_s=cfg.serve_breaker_reset_s)
+
+
 def serve_file(input_model: str, data_path: str, output_result: str,
                params: Optional[Dict] = None) -> Dict:
     """The ``task=serve`` driver: load the model into a registry,
     replay the data file through the async server as concurrent
     requests, write predictions (in row order) to `output_result`, and
     return the serving stats dict. `params` carries the serve_* knobs
-    plus loader options."""
+    plus loader options.
+
+    SIGTERM contract (single-replica half of the fleet drain): the
+    first SIGTERM stops admitting new requests, already-admitted ones
+    run to completion, predictions for every COMPLETED request are
+    still written, and the stats carry ``drained=True`` +
+    ``exit_code=EXIT_PREEMPTED`` so the caller (CLI, fleet replica
+    main) exits 75 — "terminated on purpose, re-run me" — instead of
+    dying mid-batch."""
+    import signal as signal_mod
+
     from ..cli import conform_prediction_data, write_prediction_file
     from ..config import Config
     from ..io.text_loader import load_svmlight_or_csv
     cfg = Config.from_params(params or {})
     data, _label, _w, _g = load_svmlight_or_csv(data_path,
                                                 dict(params or {}))
-    registry = ModelRegistry(max_pack_bytes=cfg.serve_cache_bytes,
-                             lowlat_max_rows=cfg.serve_lowlat_max_rows,
-                             predict_chunk_rows=cfg.tpu_predict_chunk,
-                             artifact_dir=cfg.serve_artifact_dir,
-                             compile_cache=cfg.tpu_compile_cache)
+    registry = registry_from_config(cfg)
     # validate=True: prove the model can pack + predict BEFORE the
     # server starts taking traffic on it (serving startup, not a
     # hot-swap — the upfront smoke is free relative to warm())
@@ -478,38 +563,53 @@ def serve_file(input_model: str, data_path: str, output_result: str,
     data = conform_prediction_data(np.asarray(data, np.float64),
                                    entry.model.max_feature_idx + 1,
                                    cfg.predict_disable_shape_check)
-    server = ModelServer(registry,
-                         max_batch_rows=cfg.serve_max_batch_rows,
-                         max_wait_ms=cfg.serve_max_wait_ms,
-                         deadline_ms=cfg.serve_deadline_ms,
-                         max_queue_rows=cfg.serve_max_queue_rows,
-                         retry_max=cfg.serve_retry_max,
-                         retry_backoff_ms=cfg.serve_retry_backoff_ms,
-                         breaker_threshold=cfg.serve_breaker_threshold,
-                         breaker_reset_s=cfg.serve_breaker_reset_s)
+    server = server_from_config(registry, cfg)
     metrics_port = None
     if int(cfg.serve_metrics_port) >= 0:
         metrics_port = server.start_metrics_endpoint(
             int(cfg.serve_metrics_port)).port
     sizes = request_sizes(data.shape[0], cfg.serve_request_rows)
+    drain_state = {"requested": False}
 
-    async def run() -> List[np.ndarray]:
+    async def run() -> List[Optional[np.ndarray]]:
+        loop = asyncio.get_running_loop()
+
+        def _on_sigterm() -> None:
+            drain_state["requested"] = True
+            server.begin_drain()
+
+        try:
+            loop.add_signal_handler(signal_mod.SIGTERM, _on_sigterm)
+        except (NotImplementedError, ValueError, RuntimeError):
+            pass  # non-main-thread / platform without signal support
         try:
             return await replay(server, "default", data, sizes,
-                                raw_score=cfg.predict_raw_score)
+                                raw_score=cfg.predict_raw_score,
+                                drop_rejected=True)
         finally:
+            if drain_state["requested"]:
+                await server.drain()
+            try:
+                loop.remove_signal_handler(signal_mod.SIGTERM)
+            except (NotImplementedError, ValueError, RuntimeError):
+                pass
             await server.close()
 
     t0 = time.perf_counter()
     outs = asyncio.run(run())
     elapsed = time.perf_counter() - t0
 
-    write_prediction_file(output_result, outs)
+    served = [o for o in outs if o is not None]
+    write_prediction_file(output_result, served)
 
     stats = server.stats()
-    stats.update(requests=len(outs), rows=int(data.shape[0]),
+    stats.update(requests=len(served), rows=int(data.shape[0]),
                  seconds=round(elapsed, 4),
                  rows_per_sec=round(data.shape[0] / max(elapsed, 1e-9), 1))
+    if drain_state["requested"]:
+        from ..resilience.errors import EXIT_PREEMPTED
+        stats.update(drained=True, shed=len(outs) - len(served),
+                     exit_code=EXIT_PREEMPTED)
     if metrics_port is not None:
         stats["metrics_port"] = metrics_port
     return stats

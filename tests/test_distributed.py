@@ -343,3 +343,101 @@ def test_deterministic_hist_under_sharding():
                          lgb.Dataset(X, label=y), num_boost_round=10)
     np.testing.assert_allclose(parallel.predict(X), serial.predict(X),
                                rtol=1e-4, atol=1e-4)
+
+
+class TestInitDistributedRetry:
+    """init_distributed's connect retry/backoff (ISSUE 17 satellite):
+    the fleet-restart race — every worker execs at once, the
+    coordinator binds last — must be absorbed by retries, and a dead
+    coordinator must surface as a structured DistributedInitError a
+    supervisor can match on."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_module_state(self, monkeypatch):
+        from lightgbm_tpu.parallel import distributed as dist
+        monkeypatch.setattr(dist, "_initialized", False)
+        import time
+        monkeypatch.setattr(time, "sleep", lambda s: None)
+        yield
+        dist._initialized = False
+
+    def _patch_initialize(self, monkeypatch, fn):
+        monkeypatch.setattr(jax.distributed, "initialize", fn)
+
+    def test_retries_until_coordinator_comes_up(self, monkeypatch):
+        from lightgbm_tpu.parallel import distributed as dist
+        calls = []
+
+        def flaky(**kwargs):
+            calls.append(kwargs)
+            if len(calls) < 3:
+                raise RuntimeError("connection refused")
+
+        self._patch_initialize(monkeypatch, flaky)
+        dist.init_distributed("10.0.0.1:1234", num_processes=2,
+                              process_id=0, connect_retries=4,
+                              connect_backoff_s=0.0)
+        assert len(calls) == 3
+        assert dist.is_initialized()
+        assert calls[0]["coordinator_address"] == "10.0.0.1:1234"
+
+    def test_exhausted_retries_raise_structured_error(self,
+                                                      monkeypatch):
+        from lightgbm_tpu.parallel import distributed as dist
+        from lightgbm_tpu.resilience.errors import DistributedInitError
+
+        def dead(**kwargs):
+            raise RuntimeError("connection refused")
+
+        self._patch_initialize(monkeypatch, dead)
+        with pytest.raises(DistributedInitError) as ei:
+            dist.init_distributed("10.0.0.1:1234", num_processes=2,
+                                  process_id=0, connect_retries=2,
+                                  connect_backoff_s=0.0)
+        assert ei.value.attempts == 3
+        assert isinstance(ei.value.last_error, RuntimeError)
+        assert not dist.is_initialized()
+
+    def test_misconfiguration_is_not_retried(self, monkeypatch):
+        from lightgbm_tpu.parallel import distributed as dist
+        calls = []
+
+        def misconfigured(**kwargs):
+            calls.append(kwargs)
+            raise ValueError("bad coordinator address")
+
+        self._patch_initialize(monkeypatch, misconfigured)
+        with pytest.raises(ValueError, match="bad coordinator"):
+            dist.init_distributed("nonsense", num_processes=2,
+                                  process_id=0, connect_retries=5)
+        assert len(calls) == 1  # retrying cannot fix a config error
+
+    def test_already_initialized_runtime_is_adopted(self, monkeypatch):
+        from lightgbm_tpu.parallel import distributed as dist
+
+        def already(**kwargs):
+            raise RuntimeError(
+                "Distributed runtime is already initialized")
+
+        self._patch_initialize(monkeypatch, already)
+        dist.init_distributed("10.0.0.1:1234", num_processes=2,
+                              process_id=0)
+        assert dist.is_initialized()
+
+    def test_second_call_is_idempotent(self, monkeypatch):
+        from lightgbm_tpu.parallel import distributed as dist
+        calls = []
+        self._patch_initialize(
+            monkeypatch, lambda **kw: calls.append(kw))
+        dist.init_distributed("10.0.0.1:1234", num_processes=2,
+                              process_id=0)
+        dist.init_distributed("10.0.0.1:1234", num_processes=2,
+                              process_id=0)
+        assert len(calls) == 1
+
+    def test_backoff_schedule_is_deterministic_and_capped(self):
+        from lightgbm_tpu.resilience.degrade import backoff_delays
+        assert backoff_delays(4, 0.5, cap_s=10.0) == \
+            [0.5, 1.0, 2.0, 4.0]
+        assert backoff_delays(6, 0.5, cap_s=2.0) == \
+            [0.5, 1.0, 2.0, 2.0, 2.0, 2.0]

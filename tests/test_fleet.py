@@ -1,0 +1,355 @@
+"""Serving fleet (ISSUE 17, serve half): FleetRouter over N replicas.
+
+- predict parity: the fleet answer is bit-identical to a direct
+  single-server predict (the pack contract that makes failover and
+  hedging safe).
+- failover: a dead replica (injected ``fail_dispatch``) never loses a
+  request; the dispatch faults feed the quarantine state machine and
+  the per-replica breaker, and the probe loop reinstates the replica
+  when it comes back.
+- hedged dispatch fires on a slow primary and the winning answer keeps
+  parity; divergent answers trip the asserted parity contract.
+- drain: a draining fleet sheds new requests with retry-after and
+  flushes in-flight work.
+- observability: per-replica up/quarantined gauges render in the real
+  OpenMetrics document, fleet counters accrue, and replica scrapes
+  aggregate into fleet-wide totals.
+- tools/check_fleet.py (the subprocess SIGKILL/SIGSTOP/SIGTERM chaos
+  validator) and check_perf_gate.py check 12 (availability floor).
+"""
+
+import asyncio
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.obs.export import render_openmetrics
+from lightgbm_tpu.obs.metrics import global_metrics
+from lightgbm_tpu.resilience.errors import ServerOverloaded
+from lightgbm_tpu.serve import (FleetRouter, InProcessReplica,
+                                ModelRegistry, ModelServer,
+                                aggregate_counter_totals,
+                                build_inprocess_fleet)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _data(n=300, f=6, seed=5):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.2 * r.randn(n) > 0.4)
+    return X, y.astype(np.float32)
+
+
+def _booster():
+    X, y = _data()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, y),
+                    num_boost_round=3)
+    return bst, X
+
+
+def _replica(name, bst, **server_kw):
+    registry = ModelRegistry()
+    registry.load("m", booster=bst)
+    return InProcessReplica(name, ModelServer(registry, **server_kw))
+
+
+def _fleet(bst, n=3, **kw):
+    kw.setdefault("probe_interval_ms", 10.0)
+    kw.setdefault("breaker_reset_s", 0.2)
+    return FleetRouter([_replica(f"r{i}", bst) for i in range(n)], **kw)
+
+
+async def _closed(fleet):
+    fleet.stop()
+    for rep in fleet.replicas:
+        await rep.server.close()
+
+
+class TestRouting:
+    def test_fleet_predict_bit_identical_to_direct(self):
+        bst, X = _booster()
+        fleet = _fleet(bst)
+        direct = fleet.replicas[0].server.registry.get("m") \
+            .model.predict(X[:32])
+
+        async def run():
+            out = await fleet.predict("m", X[:32])
+            assert np.array_equal(np.asarray(out), np.asarray(direct))
+            await _closed(fleet)
+
+        asyncio.run(run())
+
+    def test_failover_loses_nothing_and_quarantines(self):
+        bst, X = _booster()
+        # long breaker reset so the opened breaker is still observable
+        # after the load finishes
+        fleet = _fleet(bst, breaker_reset_s=60.0)
+        expect = fleet.replicas[0].server.registry.get("m") \
+            .model.predict(X[:8])
+        failovers0 = global_metrics.counters.get("fleet/failovers", 0)
+
+        async def run():
+            fleet.replicas[0].fail_dispatch = True
+            # round-robin sends ~1/3 of these to r0 first: enough
+            # failures to trip its breaker (threshold 5)
+            outs = await asyncio.gather(
+                *[fleet.predict("m", X[:8]) for _ in range(24)])
+            for out in outs:
+                assert np.array_equal(np.asarray(out),
+                                      np.asarray(expect))
+            await _closed(fleet)
+
+        asyncio.run(run())
+        assert global_metrics.counters["fleet/failovers"] > failovers0
+        # dispatch faults fed the probe state machine; two sweeps
+        # formalize the quarantine
+        fleet.probe_once()
+        fleet.probe_once()
+        st = fleet.stats()["replicas"]["r0"]
+        assert st["quarantined"] and not st["up"]
+        assert fleet._state["r0"].breaker.is_open
+        names = [r.name for r in fleet.healthy_replicas()]
+        assert names == ["r1", "r2"]
+
+    def test_reinstate_after_recovery(self):
+        bst, X = _booster()
+        fleet = _fleet(bst)
+        fleet.replicas[0].fail_dispatch = True
+        fleet.probe_once()
+        fleet.probe_once()
+        assert fleet.stats()["replicas"]["r0"]["quarantined"]
+        reinstates0 = global_metrics.counters.get("fleet/reinstates", 0)
+        fleet.replicas[0].fail_dispatch = False
+        fleet.probe_once()
+        fleet.probe_once()
+        assert not fleet.stats()["replicas"]["r0"]["quarantined"]
+        assert global_metrics.counters["fleet/reinstates"] \
+            == reinstates0 + 1
+        asyncio.run(_closed(fleet))
+
+    def test_whole_fleet_down_sheds_with_retry_after(self):
+        bst, X = _booster()
+        fleet = _fleet(bst, n=2)
+        for rep in fleet.replicas:
+            rep.fail_dispatch = True
+        fleet.probe_once()
+        fleet.probe_once()
+
+        async def run():
+            with pytest.raises(ServerOverloaded) as ei:
+                await fleet.predict("m", X[:4])
+            assert ei.value.retry_after_s > 0
+            await _closed(fleet)
+
+        asyncio.run(run())
+
+    def test_constructor_validation(self):
+        bst, _ = _booster()
+        with pytest.raises(ValueError, match="at least one"):
+            FleetRouter([])
+        reps = [_replica("dup", bst), _replica("dup", bst)]
+        with pytest.raises(ValueError, match="duplicate"):
+            FleetRouter(reps)
+
+    def test_build_inprocess_fleet_from_model_string(self):
+        bst, X = _booster()
+        cfg = Config.from_params({"serve_fleet_replicas": 2,
+                                  "verbosity": -1})
+        fleet = build_inprocess_fleet(bst.model_to_string(), cfg)
+        assert len(fleet.replicas) == 2
+        direct = bst.predict(X[:8])
+
+        async def run():
+            out = await fleet.predict("default", X[:8])
+            assert np.array_equal(np.asarray(out), np.asarray(direct))
+            await _closed(fleet)
+
+        asyncio.run(run())
+
+
+class TestHedging:
+    def test_hedge_fires_on_slow_primary_and_keeps_parity(self):
+        bst, X = _booster()
+
+        class SlowReplica(InProcessReplica):
+            async def predict(self, name, x, raw_score=False):
+                await asyncio.sleep(0.25)
+                return await super().predict(name, x,
+                                             raw_score=raw_score)
+
+        registry = ModelRegistry()
+        registry.load("m", booster=bst)
+        slow = SlowReplica("slow", ModelServer(registry))
+        fast = _replica("fast", bst)
+        # max_attempts=1: the answer must come from the HEDGE, not a
+        # failover retry
+        fleet = FleetRouter([slow, fast], hedge_ms=20.0,
+                            probe_interval_ms=10.0, max_attempts=1)
+        hedges0 = global_metrics.counters.get("fleet/hedges", 0)
+        expect = fast.server.registry.get("m").model.predict(X[:8])
+
+        async def run():
+            # pin the round-robin cursor so the slow replica is primary
+            while next(fleet._rr) % 2 != 1:
+                pass
+            out = await fleet.predict("m", X[:8])
+            assert np.array_equal(np.asarray(out), np.asarray(expect))
+            await asyncio.sleep(0.3)  # let the loser finish its parity
+            await _closed(fleet)
+
+        asyncio.run(run())
+        assert global_metrics.counters["fleet/hedges"] == hedges0 + 1
+
+    def test_parity_violation_is_loud(self):
+        bst, _ = _booster()
+        fleet = _fleet(bst, n=2)
+        violations0 = global_metrics.counters.get(
+            "fleet/parity_violations", 0)
+        with pytest.raises(AssertionError, match="different bits"):
+            fleet._assert_parity(np.zeros(3), np.ones(3))
+        assert global_metrics.counters["fleet/parity_violations"] \
+            == violations0 + 1
+        asyncio.run(_closed(fleet))
+
+
+class TestDrain:
+    def test_drain_sheds_new_and_flushes_inflight(self):
+        bst, X = _booster()
+        fleet = _fleet(bst)
+
+        async def run():
+            first = asyncio.ensure_future(fleet.predict("m", X[:16]))
+            await asyncio.sleep(0)
+            fleet.begin_drain()
+            with pytest.raises(ServerOverloaded, match="draining"):
+                await fleet.predict("m", X[:4])
+            assert await fleet.drain(timeout_s=10.0)
+            # the in-flight request was served, not dropped
+            out = await first
+            assert out.shape == (16,)
+            for rep in fleet.replicas:
+                await rep.server.close()
+
+        asyncio.run(run())
+
+
+class TestObservability:
+    def test_replica_gauges_render_in_openmetrics(self):
+        bst, _ = _booster()
+        fleet = _fleet(bst)
+        fleet.replicas[1].fail_dispatch = True
+        fleet.probe_once()
+        fleet.probe_once()
+        text = render_openmetrics()
+        assert "lgbmtpu_fleet_replicas 3" in text
+        assert 'lgbmtpu_fleet_replica_up{replica="r0"} 1' in text
+        assert 'lgbmtpu_fleet_replica_up{replica="r1"} 0' in text
+        assert ('lgbmtpu_fleet_replica_quarantined{replica="r1"} 1'
+                in text)
+        assert ('lgbmtpu_fleet_replica_quarantined{replica="r2"} 0'
+                in text)
+        asyncio.run(_closed(fleet))
+
+    def test_scrapes_aggregate_to_fleet_totals(self):
+        bst, X = _booster()
+        fleet = _fleet(bst, n=2)
+
+        async def run():
+            for _ in range(4):
+                await fleet.predict("m", X[:4])
+            await _closed(fleet)
+
+        asyncio.run(run())
+        totals = aggregate_counter_totals(fleet.scrape_replicas())
+        assert totals.get("lgbmtpu_serve_requests_total", 0) >= 4
+        assert totals.get("lgbmtpu_fleet_requests_total", 0) >= 4
+
+    def test_aggregate_counter_totals_pure_text(self):
+        totals = aggregate_counter_totals({
+            "a": "# HELP x_total c\nx_total 2\ny_gauge 9\n",
+            "b": 'x_total{replica="b"} 3\nz_total 1.5\n',
+        })
+        assert totals == {"x_total": 5.0, "z_total": 1.5}
+
+    def test_fleet_metrics_endpoint_ready_tracks_rotation(self):
+        import urllib.request
+        bst, _ = _booster()
+        fleet = _fleet(bst, n=2)
+        ep = fleet.start_metrics_endpoint(0)
+
+        def get(path):
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{ep.port}{path}",
+                        timeout=5) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as exc:
+                return exc.code
+
+        assert get("/readyz") == 200
+        for rep in fleet.replicas:
+            rep.fail_dispatch = True
+        fleet.probe_once()
+        fleet.probe_once()
+        assert get("/readyz") == 503
+        asyncio.run(_closed(fleet))
+
+
+class TestToolsWiring:
+    @pytest.mark.slow
+    def test_check_fleet_tool(self):
+        """The subprocess chaos validator passes in-process (quick-tier
+        wiring, same idiom as check_resilience): SIGKILL under load
+        with zero lost requests, SIGSTOP/SIGCONT quarantine cycle,
+        scrape aggregation, SIGTERM exit-75 drain."""
+        import check_fleet
+        assert check_fleet.main() == 0
+
+    def test_perf_gate_check12_skips_without_fleet_bench(self, capsys,
+                                                         tmp_path):
+        import check_perf_gate
+        with open(check_perf_gate.FLOOR_PATH) as fh:
+            floor = json.load(fh)
+        assert floor["fleet"]["min_availability"] >= 0.999
+        failures = []
+        check_perf_gate.check_fleet_availability(
+            floor, failures, str(tmp_path / "absent.json"))
+        assert failures == []
+        assert "skipped" in capsys.readouterr().out
+
+    def test_perf_gate_check12_flags_lost_requests(self, tmp_path):
+        import check_perf_gate
+        with open(check_perf_gate.FLOOR_PATH) as fh:
+            floor = json.load(fh)
+        bad = {"metric": "fleet_availability", "value": 0.9,
+               "fleet": {"availability": 0.9, "requests": 100,
+                         "served": 90, "failed": 10, "failovers": 2,
+                         "quarantines": 1, "killed_quarantined": False,
+                         "parity_ok": False}}
+        p = tmp_path / "cand.json"
+        p.write_text(json.dumps(bad))
+        failures = []
+        check_perf_gate.check_fleet_availability(floor, failures,
+                                                 str(p))
+        assert len(failures) == 3
+        assert "availability" in failures[0]
+        assert "bitwise" in failures[1]
+        assert "quarantined" in failures[2]
+
+        ok = dict(bad, value=1.0,
+                  fleet=dict(bad["fleet"], availability=1.0,
+                             served=100, failed=0,
+                             killed_quarantined=True, parity_ok=True))
+        p.write_text(json.dumps(ok))
+        failures = []
+        check_perf_gate.check_fleet_availability(floor, failures,
+                                                 str(p))
+        assert failures == []

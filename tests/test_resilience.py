@@ -612,6 +612,78 @@ class TestServeDegradation:
         assert br.state == "closed"
         br.admit()  # closed again: flows freely
 
+    def test_breaker_concurrent_tasks_single_half_open_probe(self):
+        """Two asyncio tasks racing into a half-open breaker: exactly
+        ONE wins the probe slot, the other fails fast with a
+        retry-after hint — and a probe success reopens the gate for
+        everyone (the fleet router's per-replica admission pattern)."""
+        br = CircuitBreaker("x", threshold=1, reset_s=0.02)
+        br.record_failure()
+        import time as _t
+        _t.sleep(0.03)
+        outcomes = []
+
+        async def contender(i):
+            # interleave: both tasks alive before either admits
+            await asyncio.sleep(0.001 * i)
+            try:
+                held = br.admit()
+                outcomes.append(("admitted", held))
+                if held:
+                    await asyncio.sleep(0.01)  # probe in flight
+                    br.record_success()
+            except CircuitOpenError as exc:
+                assert exc.retry_after_s > 0
+                outcomes.append(("rejected", None))
+
+        async def run():
+            await asyncio.gather(contender(0), contender(1))
+            # after the probe's success the breaker is closed: a late
+            # third task flows freely (plain admission, no probe slot)
+            assert br.admit() is False
+
+        asyncio.run(run())
+        assert sorted(o[0] for o in outcomes) == \
+            ["admitted", "rejected"]
+        assert ("admitted", True) in outcomes
+        assert br.state == "closed"
+
+    def test_breaker_concurrent_probe_failure_relocks_loser(self):
+        """Race the other way: the winning probe FAILS, re-opening the
+        breaker — a loser retrying right after must see open (with the
+        full reset window), not a free pass."""
+        br = CircuitBreaker("x", threshold=1, reset_s=60.0)
+        br.record_failure()
+        br._opened_at -= 61.0  # age the window out deterministically
+
+        async def run():
+            held = br.admit()
+            assert held is True  # half-open probe slot taken
+            with pytest.raises(CircuitOpenError):
+                br.admit()  # concurrent task: probe in flight
+            br.record_failure()  # probe verdict: still broken
+            assert br.is_open
+            with pytest.raises(CircuitOpenError) as ei:
+                br.admit()  # loser's retry hits a RE-armed open window
+            assert ei.value.retry_after_s > 1.0
+
+        asyncio.run(run())
+
+    def test_breaker_threaded_failures_open_once(self):
+        """record_failure from many executor threads at once (the
+        server reports outcomes off-loop): exactly one open transition,
+        counted once."""
+        from concurrent.futures import ThreadPoolExecutor
+        br = CircuitBreaker("x", threshold=8, reset_s=60.0)
+        opens0 = global_metrics.counters.get(
+            "resilience/breaker_open", 0)
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            list(pool.map(lambda _: br.record_failure(), range(32)))
+        assert br.is_open
+        assert br.consecutive_failures == 32
+        assert global_metrics.counters["resilience/breaker_open"] \
+            == opens0 + 1
+
     def test_registry_load_transactional(self):
         registry, X = _served()
         old_entry = registry.get("m")

@@ -1,0 +1,173 @@
+"""Distributed-training watchdog (ISSUE 17, train half).
+
+- ``Watchdog.beat`` completes a heartbeat under the deadline and
+  returns rtt/peer stats; a hung heartbeat (the ``hang_peer_at_iter``
+  fault — a peer that stops answering) blows the deadline and raises
+  a structured ``PeerLostError`` instead of joining the stall.
+- engine.train escalation: a hung peer mid-train checkpoints, flight-
+  records the miss + ``peer_lost``, and exits ``EXIT_PREEMPTED`` (75)
+  — after which a plain re-run resumes from the checkpoint to the
+  bit-identical model (the elastic-resume handoff).
+- ``from_config`` gating: the watchdog only exists (and only costs
+  anything) when ``tpu_watchdog_deadline_s`` is set.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.obs.flightrec import global_flightrec, validate_dump
+from lightgbm_tpu.obs.metrics import global_metrics
+from lightgbm_tpu.resilience import faults as faults_mod
+from lightgbm_tpu.resilience import watchdog as watchdog_mod
+from lightgbm_tpu.resilience.errors import EXIT_PREEMPTED, PeerLostError
+from lightgbm_tpu.resilience.watchdog import Watchdog
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    faults_mod.reset()
+    global_flightrec.reset()
+
+
+def _data(n=264, f=8, seed=0):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.2 * r.randn(n) > 0.4)
+    return X, y.astype(np.float32)
+
+
+class TestWatchdogUnit:
+    def test_beat_completes_under_deadline(self):
+        wd = Watchdog(deadline_s=30.0)
+        out = wd.beat(0)
+        assert out["ok"] and out["n_peers"] == 1
+        assert out["rtt_s"] < 30.0
+        assert wd.beats == 1 and wd.misses == 0
+        st = wd.stats()
+        assert st["beats"] == 1 and st["deadline_s"] == 30.0
+        assert st["worst_rtt_s"] >= st["last_rtt_s"] >= 0.0
+
+    def test_hung_heartbeat_raises_peer_lost(self):
+        faults_mod.install(faults_mod.FaultPlan(
+            hang_peer_at_iter=2, hang_peer_s=5.0))
+        wd = Watchdog(deadline_s=0.15)
+        wd.beat(0)
+        wd.beat(1)
+        before = global_metrics.counters.get(
+            "resilience/watchdog_misses", 0)
+        with pytest.raises(PeerLostError) as ei:
+            wd.beat(2)
+        assert ei.value.deadline_s == 0.15
+        assert ei.value.iteration == 2
+        assert ei.value.phase == "heartbeat"
+        assert wd.misses == 1
+        assert global_metrics.counters["resilience/watchdog_misses"] \
+            == before + 1
+
+    def test_miss_flight_records_and_dumps(self, tmp_path):
+        dump = str(tmp_path / "wd.json")
+        global_flightrec.enable(dump)
+        faults_mod.install(faults_mod.FaultPlan(
+            hang_peer_at_iter=0, hang_peer_s=5.0))
+        wd = Watchdog(deadline_s=0.15)
+        with pytest.raises(PeerLostError):
+            wd.beat(0)
+        assert os.path.exists(dump), "miss did not dump the black box"
+        with open(dump) as fh:
+            doc = json.load(fh)
+        assert validate_dump(doc) == []
+        assert doc["reason"] == "watchdog_heartbeat_miss"
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "watchdog_heartbeat_miss" in kinds
+
+    def test_closed_watchdog_stops_beating(self):
+        wd = Watchdog(deadline_s=1.0)
+        wd.close()
+        out = wd.beat(0)
+        assert out == {"ok": False, "closed": True}
+        assert wd.beats == 0
+
+    def test_from_config_gating(self):
+        assert watchdog_mod.from_config(Config()) is None
+        wd = watchdog_mod.from_config(
+            Config.from_params({"tpu_watchdog_deadline_s": 2.5}))
+        assert isinstance(wd, Watchdog) and wd.deadline_s == 2.5
+
+    def test_rtt_feeds_stats_across_beats(self):
+        wd = Watchdog(deadline_s=30.0)
+        for i in range(3):
+            wd.beat(i)
+        assert wd.beats == 3
+        assert wd.stats()["worst_rtt_s"] > 0.0
+
+
+class TestEngineEscalation:
+    def test_hung_peer_checkpoints_and_exits_75(self, tmp_path):
+        """The full contract: hang at iteration k -> PeerLostError ->
+        checkpoint + exit 75 -> plain re-run resumes to the
+        bit-identical model."""
+        X, y = _data()
+        ck = str(tmp_path / "wd.ckpt")
+        params = {"objective": "binary", "num_leaves": 7,
+                  "verbosity": -1, "tpu_checkpoint_path": ck,
+                  "tpu_checkpoint_every": 2,
+                  "tpu_watchdog_deadline_s": 0.3}
+        straight = lgb.train(dict(params), lgb.Dataset(X, y),
+                             num_boost_round=6).model_to_string()
+        os.remove(ck)
+
+        dump = str(tmp_path / "wd_dump.json")
+        global_flightrec.enable(dump)
+        faults_mod.install(faults_mod.FaultPlan(
+            hang_peer_at_iter=3, hang_peer_s=5.0))
+        with pytest.raises(SystemExit) as ei:
+            lgb.train(dict(params), lgb.Dataset(X, y),
+                      num_boost_round=6)
+        assert ei.value.code == EXIT_PREEMPTED
+        faults_mod.reset()
+        assert os.path.exists(ck), "peer loss left no checkpoint"
+        with open(dump) as fh:
+            kinds = [e["kind"] for e in json.load(fh)["events"]]
+        assert "watchdog_heartbeat_miss" in kinds
+        assert "peer_lost" in kinds
+        global_flightrec.reset()
+
+        resumed = lgb.train(dict(params), lgb.Dataset(X, y),
+                            num_boost_round=6).model_to_string()
+        assert resumed == straight, \
+            "post-peer-loss resume is not bit-identical"
+
+    def test_no_watchdog_no_overhead_path(self):
+        """Without the knob the engine never constructs a watchdog —
+        the beats counter stays untouched."""
+        before = global_metrics.counters.get(
+            "resilience/watchdog_beats", 0)
+        X, y = _data()
+        lgb.train({"objective": "binary", "num_leaves": 7,
+                   "verbosity": -1}, lgb.Dataset(X, y),
+                  num_boost_round=3)
+        assert global_metrics.counters.get(
+            "resilience/watchdog_beats", 0) == before
+
+    def test_watchdog_on_clean_run_is_silent(self, tmp_path):
+        """With the knob but no fault: beats accrue, no misses, the
+        model is bit-identical to an unwatched run."""
+        X, y = _data()
+        params = {"objective": "binary", "num_leaves": 7,
+                  "verbosity": -1}
+        plain = lgb.train(dict(params), lgb.Dataset(X, y),
+                          num_boost_round=4).predict(X)
+        before = global_metrics.counters.get(
+            "resilience/watchdog_misses", 0)
+        watched = lgb.train(
+            dict(params, tpu_watchdog_deadline_s=30.0),
+            lgb.Dataset(X, y), num_boost_round=4).predict(X)
+        assert np.array_equal(np.asarray(watched), np.asarray(plain))
+        assert global_metrics.counters.get(
+            "resilience/watchdog_misses", 0) == before

@@ -1,0 +1,280 @@
+#!/usr/bin/env python
+"""Chaos validator for the serving fleet (ISSUE 17): kill a replica
+under load, lose zero requests.
+
+Spawns a REAL 3-replica subprocess fleet (``python -m
+lightgbm_tpu.serve.fleet --replica ...``, each process its own
+ModelServer + HTTP front), fronts it with a live ``FleetRouter``, and
+drives the failure drills end-to-end:
+
+1. **SIGKILL under load** — open-loop traffic through the router;
+   one replica is SIGKILLed mid-run. Every request must still be
+   served (availability >= 99.9% — the perf-gate floor — which at
+   this request count means zero lost), every answer BIT-identical
+   to a direct in-process predict (the pack contract that makes
+   failover retries safe), and the kill must be visible in the live
+   fleet ``/metrics``: the dead replica's quarantined gauge raised,
+   the failover counter nonzero.
+2. **SIGSTOP / SIGCONT quarantine cycle** — a stopped (not dead)
+   replica times out its probes and is quarantined; after SIGCONT
+   the probe loop reinstates it without operator action — both
+   transitions observed in a real ``/metrics`` scrape, and the
+   reinstated replica answers with the same bits again.
+3. **Replica scrape aggregation** — the surviving replicas' own
+   ``/metrics`` documents merge into fleet-wide totals
+   (``aggregate_counter_totals``) that account for every request the
+   fleet served.
+4. **SIGTERM drain contract** — a surviving replica, SIGTERMed,
+   drains and exits ``EXIT_PREEMPTED`` (75): the single-replica half
+   of the fleet shutdown story.
+
+Exit 0 = all steps passed. Wired into the quick verification tier via
+tests/test_fleet.py.
+"""
+
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+N_REPLICAS = 3
+N_REQUESTS = 80
+KILL_AT = 0.4  # fraction of the trace after which the SIGKILL lands
+
+
+def _fixture(n=400, f=6, seed=7):
+    r = np.random.RandomState(seed)
+    X = r.randn(n, f)
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + 0.2 * r.randn(n) > 0.4)
+    return X, y.astype(np.float32)
+
+
+def _scrape(port: int) -> str:
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5) as resp:
+        return resp.read().decode()
+
+
+def _family(text: str, name: str, labels: str = "") -> float:
+    """Sum of a family's samples; `labels` filters on a substring of
+    the label block (e.g. 'replica="r0"')."""
+    total = 0.0
+    for line in text.splitlines():
+        if line.startswith("#") or not line.startswith(name):
+            continue
+        head, _, value = line.rpartition(" ")
+        if head != name and not head.startswith(name + "{"):
+            continue
+        if labels and labels not in head:
+            continue
+        try:
+            total += float(value)
+        except ValueError:
+            pass
+    return total
+
+
+def _spawn_replicas(model_path: str, n: int):
+    """n subprocess replicas; returns [(proc, port)] after every one
+    printed its READY rendezvous line."""
+    procs = []
+    for _ in range(n):
+        procs.append(subprocess.Popen(
+            [sys.executable, "-m", "lightgbm_tpu.serve.fleet",
+             "--replica", f"model={model_path}", "port=0",
+             "verbosity=-1"],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+            cwd=REPO, env=dict(os.environ), text=True))
+    out = []
+    for proc in procs:
+        deadline = time.time() + 120
+        port = None
+        while time.time() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if line.startswith("READY "):
+                port = int(line.split()[1])
+                break
+        if port is None:
+            raise AssertionError(
+                f"replica pid {proc.pid} never printed READY "
+                f"(rc={proc.poll()})")
+        out.append((proc, port))
+    return out
+
+
+def _wait_for(cond, timeout_s: float, what: str) -> None:
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+def main() -> int:
+    import tempfile
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.resilience.errors import EXIT_PREEMPTED
+    from lightgbm_tpu.serve import (FleetRouter, HTTPReplica,
+                                    ModelRegistry,
+                                    aggregate_counter_totals)
+
+    X, y = _fixture()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbosity": -1}, lgb.Dataset(X, y),
+                    num_boost_round=5)
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        model_path = os.path.join(tmpdir, "model.txt")
+        bst.save_model(model_path)
+        # the parity oracle: the same file packed in THIS process
+        registry = ModelRegistry()
+        registry.load("default", model_file=model_path)
+        oracle = registry.get("default").model
+
+        procs = _spawn_replicas(model_path, N_REPLICAS)
+        print(f"# spawned {N_REPLICAS} subprocess replicas: "
+              + " ".join(f"pid={p.pid}:port={port}"
+                         for p, port in procs))
+        fleet = FleetRouter(
+            [HTTPReplica(f"r{i}", f"http://127.0.0.1:{port}")
+             for i, (_, port) in enumerate(procs)],
+            probe_interval_ms=40.0, breaker_reset_s=0.25).start()
+        ep = fleet.start_metrics_endpoint(0)
+        try:
+            _run_drills(fleet, ep, procs, oracle, X, EXIT_PREEMPTED,
+                        aggregate_counter_totals)
+        finally:
+            fleet.stop()
+            for proc, _ in procs:
+                if proc.poll() is None:
+                    proc.kill()
+                proc.wait()
+                proc.stdout.close()
+    print("# fleet chaos validator OK (4/4 steps)")
+    return 0
+
+
+def _run_drills(fleet, ep, procs, oracle, X, EXIT_PREEMPTED,
+                aggregate_counter_totals) -> None:
+    rng = np.random.RandomState(0)
+    _wait_for(lambda: len(fleet.healthy_replicas()) == N_REPLICAS,
+              30, "all replicas in rotation")
+
+    # ---- step 1: SIGKILL one replica under open-loop load -----------
+    sizes = rng.randint(1, 48, size=N_REQUESTS)
+    starts = np.concatenate([[0], np.cumsum(sizes)[:-1]])
+    kill_idx = int(KILL_AT * N_REQUESTS)
+    answers = [None] * N_REQUESTS
+    failed = []
+
+    async def one(i: int) -> None:
+        await asyncio.sleep(i * 0.01)  # open loop: ~100 req/s offered
+        if i == kill_idx:
+            procs[0][0].kill()  # SIGKILL, not a graceful drain
+        lo = int(starts[i]) % (len(X) - 48)
+        try:
+            answers[i] = await fleet.predict(
+                "default", X[lo:lo + int(sizes[i])])
+        except Exception as exc:
+            failed.append((i, type(exc).__name__))
+
+    async def load_phase() -> None:
+        await asyncio.gather(*[one(i) for i in range(N_REQUESTS)])
+
+    asyncio.run(load_phase())
+    served = N_REQUESTS - len(failed)
+    availability = served / N_REQUESTS
+    assert availability >= 0.999, (
+        f"lost {len(failed)}/{N_REQUESTS} requests across the SIGKILL "
+        f"(availability {availability:.4%}): {failed[:5]}")
+    for i in range(N_REQUESTS):
+        lo = int(starts[i]) % (len(X) - 48)
+        expect = oracle.predict(X[lo:lo + int(sizes[i])])
+        assert np.array_equal(np.asarray(answers[i]),
+                              np.asarray(expect)), (
+            f"request {i} served across the kill is NOT bit-identical "
+            "to a direct predict")
+    _wait_for(lambda: fleet.stats()["replicas"]["r0"]["quarantined"],
+              10, "the killed replica's quarantine")
+    text = _scrape(ep.port)
+    assert _family(text, "lgbmtpu_fleet_replica_quarantined",
+                   'replica="r0"') == 1, \
+        "killed replica not quarantined in the live /metrics scrape"
+    failovers = _family(text, "lgbmtpu_fleet_failovers_total")
+    quarantines = _family(text, "lgbmtpu_fleet_quarantines_total")
+    assert failovers >= 1, "SIGKILL produced no failover counter"
+    assert quarantines >= 1, "SIGKILL produced no quarantine counter"
+    print(f"# step 1 OK: SIGKILL@{kill_idx}/{N_REQUESTS} -> "
+          f"{served}/{N_REQUESTS} served ({availability:.4%}), all "
+          f"bit-identical; /metrics shows r0 quarantined, "
+          f"{failovers:.0f} failover(s)")
+
+    # ---- step 2: SIGSTOP/SIGCONT quarantine + reinstate cycle -------
+    os.kill(procs[1][0].pid, signal.SIGSTOP)
+    try:
+        _wait_for(
+            lambda: fleet.stats()["replicas"]["r1"]["quarantined"],
+            15, "the stopped replica's quarantine")
+        assert _family(_scrape(ep.port),
+                       "lgbmtpu_fleet_replica_quarantined",
+                       'replica="r1"') == 1, \
+            "stopped replica not quarantined in the live scrape"
+        out = asyncio.run(fleet.predict("default", X[:8]))
+        assert np.array_equal(np.asarray(out),
+                              np.asarray(oracle.predict(X[:8]))), \
+            "predict during the SIGSTOP window lost bit parity"
+    finally:
+        os.kill(procs[1][0].pid, signal.SIGCONT)
+    _wait_for(
+        lambda: not fleet.stats()["replicas"]["r1"]["quarantined"],
+        15, "the resumed replica's reinstatement")
+    text = _scrape(ep.port)
+    assert _family(text, "lgbmtpu_fleet_replica_quarantined",
+                   'replica="r1"') == 0, \
+        "resumed replica still quarantined in the live scrape"
+    assert _family(text, "lgbmtpu_fleet_reinstates_total") >= 1, \
+        "SIGCONT produced no reinstate counter"
+    out = asyncio.run(fleet.predict("default", X[:8]))
+    assert np.array_equal(np.asarray(out),
+                          np.asarray(oracle.predict(X[:8]))), \
+        "predict after reinstatement lost bit parity"
+    print("# step 2 OK: SIGSTOP -> quarantined, SIGCONT -> reinstated "
+          "(both in live /metrics), bits unchanged throughout")
+
+    # ---- step 3: replica scrape aggregation -------------------------
+    totals = aggregate_counter_totals(fleet.scrape_replicas())
+    served_by_replicas = totals.get("lgbmtpu_serve_requests_total", 0.0)
+    assert served_by_replicas >= N_REQUESTS - kill_idx, (
+        f"survivor replicas account for only {served_by_replicas:.0f} "
+        "served requests in their own /metrics")
+    print(f"# step 3 OK: surviving replicas' scrapes aggregate to "
+          f"{served_by_replicas:.0f} lgbmtpu_serve_requests_total")
+
+    # ---- step 4: SIGTERM drain contract on a survivor ---------------
+    survivor = procs[2][0]
+    survivor.terminate()  # SIGTERM: drain, deregister, exit 75
+    rc = survivor.wait(timeout=60)
+    assert rc == EXIT_PREEMPTED, (
+        f"SIGTERMed replica exited {rc}, expected EXIT_PREEMPTED "
+        f"({EXIT_PREEMPTED})")
+    print(f"# step 4 OK: SIGTERM -> graceful drain -> exit "
+          f"{EXIT_PREEMPTED}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

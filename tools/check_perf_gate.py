@@ -2,7 +2,7 @@
 """Perf-regression gate (ROADMAP item 4: convert "should be fast" into
 driver-visible proof).
 
-Eight checks, all against the recorded floor in tools/perf_floor.json:
+Twelve checks, all against the recorded floor in tools/perf_floor.json:
 
 1. **Histogram traffic model** — recomputes the static per-iteration
    HBM byte model (learner.hist_traffic_model) for the recorded
@@ -90,6 +90,14 @@ Eight checks, all against the recorded floor in tools/perf_floor.json:
     the best per-tag utilization vs the hostenv.platform_peaks row
     must clear the RATCHETING ``min_utilization`` floor. Graceful skip
     when no profiled bench ran or the record is unattributable.
+
+12. **Fleet availability** — over the latest bench record carrying a
+    ``fleet`` summary (bench.py --fleet: open-loop load through the
+    FleetRouter with one replica killed at the 40% mark): the served
+    fraction must clear the ``min_availability`` floor (0.999), the
+    killed replica must land in quarantine, and the served answers
+    must stay bit-identical to a direct predict (check_fleet_
+    availability). Graceful skip when no fleet bench ran.
 
 Exit 0 = gate passed; exit 1 = regression, with one line per failure.
 Wired into the quick verification tier via tests/test_perf_gate.py.
@@ -750,6 +758,59 @@ def check_profile_roofline(floor, failures, candidate_path=None):
               f"{best_util:.2e}, {len(by_tag)} tag(s) {verdicts}")
 
 
+def check_fleet_availability(floor, failures, candidate_path=None):
+    """Fleet chaos availability (check 12): over the latest bench
+    record carrying a ``fleet`` summary (bench.py --fleet — open-loop
+    load through the FleetRouter with one replica killed at the 40%
+    mark), the served fraction must clear the floor-configured
+    ``min_availability`` (ISSUE 17: kill a replica under load, lose
+    zero requests), the killed replica must have been quarantined, and
+    every served answer must have stayed bit-identical to a direct
+    predict (the pack contract that makes failover retries safe).
+    No fleet bench recorded => the check reports itself skipped."""
+    cfg = floor.get("fleet")
+    if not cfg:
+        print("# no fleet floor recorded; fleet-availability check "
+              "skipped")
+        return
+    recs = _load_keyed_records("fleet", candidate_path)
+    if not recs:
+        print("# no fleet bench recorded; fleet-availability check "
+              "skipped")
+        return
+    tag, rec = recs[-1]
+    ft = rec["fleet"]
+    total = int(ft.get("requests", 0))
+    if total <= 0:
+        print(f"# fleet[{tag}]: no requests recorded; "
+              "fleet-availability check skipped")
+        return
+    n_fail0 = len(failures)
+    availability = float(ft.get("availability", 0.0))
+    min_avail = float(cfg.get("min_availability", 0.999))
+    if availability < min_avail:
+        failures.append(
+            f"{tag}: fleet availability {availability:.4%} over {total} "
+            f"request(s) with a mid-run replica kill is under the "
+            f"{min_avail:.1%} floor — failover is dropping requests")
+    if not ft.get("parity_ok", True):
+        failures.append(
+            f"{tag}: fleet answers diverged bitwise from a direct "
+            "predict — the idempotent-failover pack contract is broken")
+    if "killed_quarantined" in ft and not ft["killed_quarantined"]:
+        failures.append(
+            f"{tag}: the killed replica was never quarantined — the "
+            "health probe loop is not converting dispatch failures "
+            "into routing decisions")
+    if len(failures) == n_fail0:
+        print(f"# fleet[{tag}]: availability {availability:.4%} over "
+              f"{total} request(s) ({int(ft.get('failovers', 0))} "
+              f"failover(s), {int(ft.get('quarantines', 0))} "
+              f"quarantine(s), fleet p99 {ft.get('p99_ms', 0)}ms vs "
+              f"single {ft.get('single_p99_ms', 0)}ms; floor "
+              f"{min_avail:.1%})")
+
+
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
@@ -809,6 +870,7 @@ def main(argv=None) -> int:
     check_stream_overhead(floor, failures, candidate)
     check_coldstart(floor, failures, candidate)
     check_profile_roofline(floor, failures, candidate)
+    check_fleet_availability(floor, failures, candidate)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")
