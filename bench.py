@@ -65,7 +65,7 @@ RELAY_PORTS = (8082, 8083, 8087)
 
 
 _BENCH_MODES = ("train", "predict", "serve", "continual", "stream",
-                "coldstart", "fleet")
+                "coldstart", "fleet", "shap", "rank")
 
 
 def parse_bench_mode(argv=None, environ=None) -> str:
@@ -189,7 +189,7 @@ def _replay_child_stderr(path: str) -> None:
 _MODE_DEFAULT_ROWS = {"train": 10_500_000, "predict": 8_000_000,
                       "serve": 2_000_000, "continual": 2_000_000,
                       "stream": 10_500_000, "coldstart": 20_000,
-                      "fleet": 500_000}
+                      "fleet": 500_000, "shap": 200_000, "rank": 500_000}
 # CPU-fallback shard sizes: the 1-core host must finish in budget (see
 # the fallback comment below); inference modes keep more rows than
 # training, and --serve pays per-request scheduling on top of traversal.
@@ -197,14 +197,20 @@ _MODE_DEFAULT_ROWS = {"train": 10_500_000, "predict": 8_000_000,
 # be big enough that cold compile dominates, so CPU keeps the default.
 _MODE_CPU_ROWS = {"train": 50_000, "predict": 300_000, "serve": 150_000,
                   "continual": 40_000, "stream": 50_000,
-                  "coldstart": 20_000, "fleet": 60_000}
+                  "coldstart": 20_000, "fleet": 60_000,
+                  # --shap pays paths x depth per row, --rank pays
+                  # pairwise lambdarank gradients per iteration: both
+                  # far heavier per row than plain traversal/training
+                  "shap": 20_000, "rank": 30_000}
 _MODE_METRIC = {"train": "boosting_iters_per_sec_higgs_shape",
                 "predict": "predict_rows_per_sec",
                 "serve": "serve_rows_per_sec",
                 "continual": "continual_rows_per_sec",
                 "stream": "stream_rows_per_sec",
                 "coldstart": "coldstart_compile_reduction",
-                "fleet": "fleet_availability"}
+                "fleet": "fleet_availability",
+                "shap": "contrib_rows_per_sec",
+                "rank": "rank_train_rows_per_sec"}
 
 
 def main():
@@ -542,6 +548,15 @@ def _random_trees(rng, num_trees: int, num_leaves: int, num_features: int):
             slot[leaf] = (s, 0)
             slot[s + 1] = (s, 1)
         tr.leaf_value[:] = rng.randn(num_leaves) * 0.1
+        # synthetic cover counts so the SHAP bench can form z-fractions
+        # (child_count / parent_count); internal counts are the exact
+        # subtree sums, built children-first (node s's children are
+        # always leaves or internal nodes > s)
+        tr.leaf_count[:] = rng.randint(1, 100, num_leaves)
+        for s in reversed(range(num_leaves - 1)):
+            tr.internal_count[s] = sum(
+                tr.leaf_count[~c] if c < 0 else tr.internal_count[c]
+                for c in (tr.left_child[s], tr.right_child[s]))
         trees.append(tr)
     return trees
 
@@ -634,6 +649,228 @@ def _measure_predict():
           "speedup=%.2fx bit_equal=%s"
           % (platform, engine_rps, scan_rps, engine_rps / max(scan_rps, 1e-9),
              bit_equal), file=sys.stderr)
+
+
+def _measure_shap():
+    """Explanation bench: SHAP-contribution rows/sec through the batched
+    device TreeSHAP kernel (ops/shap.py, path-decomposed pack) vs the
+    reference recursive host oracle measured in the SAME run on a row
+    subset — the per-row recursion cost is row-count-independent, so the
+    subset extrapolates. Parity between the two is asserted on that
+    subset before timing; the path-table pack bytes ride along so the
+    perf gate can band them against the analytic memory model."""
+    n = int(os.environ.get("BENCH_ROWS", 200_000))
+    t = int(os.environ.get("BENCH_SHAP_TREES", 50))
+    leaves = int(os.environ.get("BENCH_SHAP_LEAVES", 31))
+    f = 28
+    chunk = int(os.environ.get("BENCH_SHAP_CHUNK", 4096))
+
+    import jax
+    from lightgbm_tpu.compile_cache import configure as _cache_configure
+    _cache_configure("auto")
+    from lightgbm_tpu.ops import predict as pred_ops
+    from lightgbm_tpu.ops import shap as shap_ops
+    from lightgbm_tpu import shap as shap_host
+    from lightgbm_tpu.obs.memory import predict_memory_model
+
+    platform = jax.default_backend()
+    rng = np.random.RandomState(0)
+    trees = _random_trees(rng, t, leaves, f)
+    data = rng.randn(n, f).astype(np.float64)
+    data[::11, 3] = np.nan  # exercise the missing-routing tables
+
+    class _Owner:  # packed path-table cache host
+        pass
+
+    owner = _Owner()
+
+    def device_run():
+        return shap_ops.shap_contrib_cached(owner, trees, 1, data, f,
+                                            "bench", chunk)
+
+    # host recursive oracle on a subset: minutes per thousand rows at
+    # this tree count, so the subset carries the baseline
+    n_oracle = min(int(os.environ.get("BENCH_SHAP_ORACLE_ROWS", 128)), n)
+    t0 = time.time()
+    oracle = shap_host._contrib_over_trees(
+        lambda it, ki: trees[it], t, 1, data[:n_oracle], f, 0, -1)
+    oracle_rps = n_oracle / (time.time() - t0)
+
+    dev = device_run()  # compile + warm (and the parity source)
+    scale = max(np.abs(oracle).max(), 1.0)
+    rel_err = float(np.abs(dev[:n_oracle] - oracle).max() / scale)
+    bit_equal = rel_err <= 2e-3  # f32 recurrence noise vs f64 recursion
+
+    reps = int(os.environ.get("BENCH_SHAP_REPS", 3))
+    t0 = time.time()
+    for _ in range(reps):
+        device_run()
+    device_rps = n * reps / (time.time() - t0)
+
+    packer = pred_ops._get_packer(owner, "bench")
+    pack = packer.shap_update(trees, 1, f, chunk_rows=chunk)  # cached
+    model = predict_memory_model(
+        num_rows=n, num_features=f, num_trees=t, num_leaves=leaves,
+        chunk_rows=chunk, contrib=True)
+
+    unit = "rows/sec (N=%d, T=%d, %d leaves" % (n, t, leaves)
+    if platform != "tpu":
+        unit += ", platform=%s" % platform
+    if not bit_equal:
+        unit += ", PARITY-MISMATCH"
+    unit += ")"
+    result = {
+        "metric": "contrib_rows_per_sec",
+        "value": round(device_rps, 1),
+        "unit": unit,
+        # anchor: speedup over the reference recursion this kernel
+        # replaced (perf-gate check 13 floors this)
+        "vs_baseline": round(device_rps / max(oracle_rps, 1e-9), 4),
+        "shap": {
+            "device_rows_per_sec": round(device_rps, 1),
+            "oracle_rows_per_sec": round(oracle_rps, 2),
+            "oracle_rows": n_oracle,
+            "oracle_rel_err": round(rel_err, 8),
+            "paths": int(pack.num_paths),
+            "depth": int(pack.depth),
+            "pack_bytes": int(2 * packer.shap_nbytes),
+            "model_pack_bytes": int(model["components"]["shap_pack"]),
+            "chunk_rows": chunk,
+        },
+    }
+    out_path = os.environ.get("BENCH_OUT")
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(json.dumps(result) + "\n")
+    else:
+        print(json.dumps(result), flush=True)
+    print("# platform=%s device=%.0f rows/s oracle=%.1f rows/s "
+          "speedup=%.1fx paths=%d depth=%d rel_err=%.2g"
+          % (platform, device_rps, oracle_rps,
+             device_rps / max(oracle_rps, 1e-9), pack.num_paths,
+             pack.depth, rel_err), file=sys.stderr)
+
+
+def _measure_rank():
+    """Ranking bench: lambdarank training rows/sec on a synthetic
+    query/document fixture plus a served smoke trace of the trained
+    ranker — the first recorded datapoint for the ranking objective.
+    vs_baseline anchors lambdarank against a pointwise binary train of
+    the SAME shape in the same run (the pairwise-gradient overhead)."""
+    import asyncio
+
+    n = int(os.environ.get("BENCH_ROWS", 500_000))
+    f = 20
+    qsize = int(os.environ.get("BENCH_RANK_QUERY_SIZE", 20))
+    iters = int(os.environ.get("BENCH_RANK_ITERS", 10))
+    warmup = 2
+
+    import jax
+    from lightgbm_tpu.compile_cache import configure as _cache_configure
+    _cache_configure("auto")
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import ModelRegistry, ModelServer, replay
+    from lightgbm_tpu.obs.metrics import global_metrics
+
+    platform = jax.default_backend()
+    rng = np.random.RandomState(0)
+    n_query = max(n // qsize, 1)
+    n = n_query * qsize
+    x = rng.randn(n, f)
+    group = np.full(n_query, qsize, np.int32)
+    # graded relevance 0..3: a noisy monotone function of two features
+    score = x[:, 0] + 0.5 * x[:, 3] + rng.randn(n) * 0.7
+    y = np.clip(np.digitize(score, (-1.0, 0.3, 1.5)), 0, 3).astype(
+        np.float64)
+
+    params = {"objective": "lambdarank", "num_leaves": 63,
+              "learning_rate": 0.1, "verbosity": -1}
+    ds = lgb.Dataset(x, label=y, group=group, params=params)
+    t0 = time.time()
+    bst = lgb.train(params, ds, num_boost_round=warmup)
+    warm_time = time.time() - t0
+    t0 = time.time()
+    bst = lgb.train(params, ds, num_boost_round=warmup + iters)
+    rank_rps = n * (warmup + iters) / (time.time() - t0)
+
+    # pointwise anchor: binary train, identical data shape and leaves
+    p2 = dict(params, objective="binary")
+    yb = (y >= 2).astype(np.float64)
+    ds2 = lgb.Dataset(x, label=yb, params=p2)
+    lgb.train(p2, ds2, num_boost_round=warmup)
+    t0 = time.time()
+    lgb.train(p2, ds2, num_boost_round=warmup + iters)
+    binary_rps = n * (warmup + iters) / (time.time() - t0)
+
+    # quality sanity: mean NDCG@5 of the trained ranker over the queries
+    pred = bst.predict(x, raw_score=True)
+    gains, ndcg = 2.0 ** y - 1.0, []
+    disc = 1.0 / np.log2(np.arange(2, qsize + 2))
+    for q in range(min(n_query, 2000)):
+        sl = slice(q * qsize, (q + 1) * qsize)
+        g, p = gains[sl], pred[sl]
+        ideal = (np.sort(g)[::-1][:5] * disc[:5]).sum()
+        if ideal <= 0:
+            continue
+        got = (g[np.argsort(-p)][:5] * disc[:5]).sum()
+        ndcg.append(got / ideal)
+    ndcg5 = float(np.mean(ndcg)) if ndcg else 0.0
+
+    # serve smoke: the trained ranker behind ModelServer, mixed-size
+    # trace (lowlat + coalesced), request latency reservoir
+    registry = ModelRegistry()
+    registry.load("rank", booster=bst)
+    server = ModelServer(registry, max_batch_rows=8192, max_wait_ms=2.0)
+    server.warm("rank", f)
+    smoke_rows = min(n, int(os.environ.get("BENCH_RANK_SERVE_ROWS",
+                                           100_000)))
+    sizes = _serve_request_sizes(rng, smoke_rows)
+    global_metrics.reset_latency("serve/request")
+
+    async def run():
+        try:
+            await replay(server, "rank", x[:smoke_rows], sizes,
+                         raw_score=True)
+        finally:
+            await server.close()
+
+    t0 = time.time()
+    asyncio.run(run())
+    serve_rps = smoke_rows / (time.time() - t0)
+    lat = global_metrics.latency_summary("serve/request")
+
+    unit = ("rows/sec (N=%d, %d queries x %d docs, %d iters"
+            % (n, n_query, qsize, warmup + iters))
+    if platform != "tpu":
+        unit += ", platform=%s" % platform
+    unit += ")"
+    result = {
+        "metric": "rank_train_rows_per_sec",
+        "value": round(rank_rps, 1),
+        "unit": unit,
+        # anchor: lambdarank vs pointwise binary training, same shape
+        "vs_baseline": round(rank_rps / max(binary_rps, 1e-9), 4),
+        "rank": {
+            "train_rows_per_sec": round(rank_rps, 1),
+            "binary_rows_per_sec": round(binary_rps, 1),
+            "train_ndcg5": round(ndcg5, 4),
+            "serve_rows_per_sec": round(serve_rps, 1),
+            "serve_p50_ms": lat["p50_ms"],
+            "serve_p99_ms": lat["p99_ms"],
+            "serve_requests": len(sizes),
+        },
+    }
+    out_path = os.environ.get("BENCH_OUT")
+    if out_path:
+        with open(out_path, "w") as fh:
+            fh.write(json.dumps(result) + "\n")
+    else:
+        print(json.dumps(result), flush=True)
+    print("# platform=%s rank=%.0f rows/s binary=%.0f rows/s "
+          "ndcg@5=%.3f serve=%.0f rows/s p50=%.2fms p99=%.2fms "
+          "(first train warmup %.1fs)"
+          % (platform, rank_rps, binary_rps, ndcg5, serve_rps,
+             lat["p50_ms"], lat["p99_ms"], warm_time), file=sys.stderr)
 
 
 def _serve_request_sizes(rng, total_rows: int):
@@ -1357,7 +1594,8 @@ def _measure_coldstart():
 _MODE_MEASURE = {"train": _measure, "predict": _measure_predict,
                  "serve": _measure_serve, "fleet": _measure_fleet,
                  "continual": _measure_continual,
-                 "stream": _measure_stream, "coldstart": _measure_coldstart}
+                 "stream": _measure_stream, "coldstart": _measure_coldstart,
+                 "shap": _measure_shap, "rank": _measure_rank}
 
 
 def _emit_partial_obs(mode: str, exc) -> None:

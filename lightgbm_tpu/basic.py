@@ -607,7 +607,8 @@ class Booster:
             if pred_contrib:
                 from .shap import loaded_pred_contrib
                 return loaded_pred_contrib(self._loaded, data,
-                                           start_iteration, num_iteration)
+                                           start_iteration, num_iteration,
+                                           predict_chunk=predict_chunk)
             if pred_leaf:
                 return self._loaded.predict_leaf(
                     data, start_iteration=start_iteration,

@@ -2115,7 +2115,8 @@ class GBDT:
         if pred_leaf:
             return self.predict_leaf(data, start_iteration, num_iteration)
         if pred_contrib:
-            return self.predict_contrib(data, start_iteration, num_iteration)
+            return self.predict_contrib(data, start_iteration, num_iteration,
+                                        predict_chunk=predict_chunk)
         raw = self.predict_raw(data, start_iteration, num_iteration,
                                predict_chunk=predict_chunk)
         if raw.shape[1] == 1:
@@ -2137,11 +2138,15 @@ class GBDT:
             np.zeros((data.shape[0], 0), np.int32)
 
     def predict_contrib(self, data: np.ndarray, start_iteration: int = 0,
-                        num_iteration: int = -1) -> np.ndarray:
+                        num_iteration: int = -1,
+                        predict_chunk: Optional[int] = None) -> np.ndarray:
         """SHAP values via the tree-path algorithm (ref: tree.h
-        PredictContrib; simplified path-dependent implementation)."""
+        PredictContrib). Routed through the batched device kernel
+        (ops/shap.py) unless config.tpu_shap says off or the model has
+        linear-tree leaves (shap.py owns the dispatch)."""
         from .shap import predict_contrib
-        return predict_contrib(self, data, start_iteration, num_iteration)
+        return predict_contrib(self, data, start_iteration, num_iteration,
+                               predict_chunk=predict_chunk)
 
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split",

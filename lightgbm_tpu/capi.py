@@ -715,7 +715,9 @@ def booster_predict_for_file(handle: int, data_filename: str,
     X, _y, _w, _g = load_svmlight_or_csv(data_filename, params)
     bst = _get(handle)
     canon = {Config.canonical_key(pk): pv for pk, pv in params.items()}
-    chunk = canon.get("tpu_predict_chunk")  # per-call serving override
+    # per-call serving override; also caps the SHAP row chunks when
+    # predict_type is contribution (ops/shap.py)
+    chunk = canon.get("tpu_predict_chunk")
     pred = bst.predict(X, start_iteration=start_iteration,
                        num_iteration=num_iteration,
                        raw_score=predict_type == _PREDICT_RAW,

@@ -182,6 +182,7 @@ _ALIASES: Dict[str, List[str]] = {
     "deterministic_hist": ["tpu_deterministic_hist"],
     "tpu_dart_fused_max_bytes": [],
     "tpu_predict_chunk": ["predict_chunk", "predict_chunk_rows"],
+    "tpu_shap": ["shap", "pred_contrib_device", "tpu_pred_contrib"],
     "tpu_preflight": ["preflight", "memory_preflight"],
     "tpu_health": ["health", "training_health"],
     "tpu_health_every": ["health_every", "health_check_every"],
@@ -593,6 +594,14 @@ class Config:
     # tail pads up to a power-of-two bucket — so any N reuses a small
     # fixed set of compiled traversal programs.
     tpu_predict_chunk: int = 1 << 20
+    # TreeSHAP routing for predict(pred_contrib=True): "auto"/"on" run
+    # the batched path-decomposed device kernel (ops/shap.py) — linear-
+    # tree models always take the host path, which raises the
+    # reference's linear-tree restriction — "off" forces the host
+    # recursion (the parity oracle). Row chunks reuse
+    # tpu_predict_chunk, internally capped (the per-row working set
+    # scales with paths x depth, so SHAP streams smaller blocks).
+    tpu_shap: str = "auto"
     # HBM capacity preflight (obs/memory.py): the analytic peak-memory
     # model is compared against device capacity at booster construction;
     # "warn" logs the verdict plus concrete knob recommendations when it

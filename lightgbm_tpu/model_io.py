@@ -176,6 +176,19 @@ class LoadedModel:
         return np.stack(cols, axis=1) if cols else \
             np.zeros((data.shape[0], 0), np.int32)
 
+    def predict_contrib(self, data: np.ndarray, start_iteration: int = 0,
+                        num_iteration: int = -1,
+                        predict_chunk: Optional[int] = None) -> np.ndarray:
+        """[N, K * (F + 1)] SHAP contributions (last slot per class is
+        the expected value). Dispatches to the batched device kernel
+        (ops/shap.py) via shap.py; the serve `explain` route calls
+        this, so served explanations and direct pred_contrib run the
+        identical program and return bit-equal outputs."""
+        from .shap import loaded_pred_contrib
+        return loaded_pred_contrib(self, data, start_iteration,
+                                   num_iteration,
+                                   predict_chunk=predict_chunk)
+
     def predict(self, data: np.ndarray, raw_score: bool = False,
                 **kwargs) -> np.ndarray:
         raw = self.predict_raw(data, **kwargs)

@@ -152,6 +152,22 @@ def render_openmetrics(registry=None,
         doc.sample(fam, "summary", res.count,
                    labels={"name": name}, name=fam + "_count")
 
+    # served-explanation latency: the generic block above already
+    # carries name="explain/request"; this dedicated family gives the
+    # explain SLO its own stable name, mirroring how the serve
+    # dashboards key on lgbmtpu_latency_seconds{name="serve/request"}
+    # (family linted by tools/check_shap.py)
+    res = reservoirs.get("explain/request")
+    if res is not None and res.count:
+        fam = "lgbmtpu_explain_latency_seconds"
+        p50, p95, p99 = res.quantiles((0.50, 0.95, 0.99))
+        for q, v in (("0.5", p50), ("0.95", p95), ("0.99", p99)):
+            doc.sample(fam, "summary", v, labels={"quantile": q},
+                       help_text="served SHAP-explanation request "
+                                 "latency (ModelServer.explain)")
+        doc.sample(fam, "summary", res.total_seconds, name=fam + "_sum")
+        doc.sample(fam, "summary", res.count, name=fam + "_count")
+
     # predict throughput accumulators (always-on)
     doc.sample("lgbmtpu_predict_rows_total", "counter",
                reg.predict_rows_total)

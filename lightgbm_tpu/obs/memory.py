@@ -325,7 +325,10 @@ def predict_memory_model(*, num_rows: int, num_features: int,
                          num_trees: int, num_leaves: int,
                          num_class: int = 1, chunk_rows: int = 1 << 20,
                          pack_nbytes: Optional[int] = None,
-                         resident_pack_bytes: int = 0) -> Dict[str, Any]:
+                         resident_pack_bytes: int = 0,
+                         contrib: bool = False,
+                         shap_pack_nbytes: Optional[int] = None
+                         ) -> Dict[str, Any]:
     """Analytic peak-HBM model of a serving dispatch: the device
     ensemble pack plus one chunk's traversal working set.
 
@@ -340,7 +343,16 @@ def predict_memory_model(*, num_rows: int, num_features: int,
                     leaf gather + [B, K] f64 output
     - ``resident_pack_bytes`` adds OTHER models' packs already resident
       (the serve registry's budgeted pool) so multi-tenant preflight
-      sees the whole pool, not one model."""
+      sees the whole pool, not one model.
+
+    With ``contrib=True`` the pred_contrib (TreeSHAP) dispatch is
+    modeled instead of plain traversal: the depth-padded path-table
+    pack (measured ``EnsemblePacker.shap_nbytes*2`` via
+    ``shap_pack_nbytes`` when it exists; analytic T*L paths x padded
+    depth x 14 f32 tables otherwise) plus the kernel's [B, Pc, D]
+    pweight working set, which the packer sizes against its own
+    128 MB budget (ops/predict._SHAP_BUDGET_BYTES) — the band
+    tools/check_perf_gate.py check 13 holds the measured pack to."""
     t = int(num_trees)
     l = int(num_leaves)
     if pack_nbytes is None:
@@ -357,6 +369,29 @@ def predict_memory_model(*, num_rows: int, num_features: int,
         "chunk_state": chunk * t * I32,
         "chunk_out": chunk * t * F32 + chunk * max(int(num_class), 1) * F64,
     }
+    if contrib:
+        from ..ops.predict import _SHAP_BUDGET_BYTES
+        from ..ops.shap import MAX_CHUNK_ROWS
+        paths = t * l
+        # unique path elements ~ tree depth ~ log2(L) (+1 dummy slot),
+        # padded to a multiple of 4 like the packer's depth bucketing
+        d_est = max(l - 1, 1).bit_length() + 1
+        depth = max(-(-d_est // 4) * 4, 4)
+        if shap_pack_nbytes is None:
+            # 13 path tables + leaf values: one 4-byte cell per slot
+            shap_host = paths * depth * 14 * F32
+        else:
+            shap_host = int(shap_pack_nbytes)
+        cchunk = min(chunk, MAX_CHUNK_ROWS)
+        # [B, Pc, D] f32 recurrence tensors (~6 live at the extend/
+        # unwind peak); Pc is the pow2 path-chunk the packer fits into
+        # its budget, floored at 32 and capped at the path count
+        per_path = cchunk * depth * F32 * 6
+        pc = 1 << max(int(_SHAP_BUDGET_BYTES // max(per_path, 1)
+                          ).bit_length() - 1, 0)
+        pc = max(min(pc, _pow2(max(paths, 1))), 32)
+        comp["shap_pack"] = 2 * shap_host
+        comp["shap_chunk"] = pc * per_path
     peak = sum(comp.values())
     return {
         "kind": "predict",
@@ -368,7 +403,8 @@ def predict_memory_model(*, num_rows: int, num_features: int,
         "params": dict(num_rows=int(num_rows),
                        num_features=int(num_features), num_trees=t,
                        num_leaves=l, num_class=int(num_class),
-                       chunk_rows=int(chunk_rows)),
+                       chunk_rows=int(chunk_rows),
+                       contrib=bool(contrib)),
     }
 
 
@@ -634,17 +670,22 @@ def preflight_predict(*, num_rows: int, num_features: int, num_trees: int,
                       chunk_rows: int = 1 << 20,
                       pack_nbytes: Optional[int] = None,
                       resident_pack_bytes: int = 0,
+                      contrib: bool = False,
+                      shap_pack_nbytes: Optional[int] = None,
                       capacity_bytes: Optional[int] = None
                       ) -> PreflightReport:
     """Serving-side capacity check (hooked into ModelRegistry.load):
     ensemble pack + chunk working set vs device capacity, recommending
     a smaller ``tpu_predict_chunk`` when the chunk buffers are what
-    doesn't fit."""
+    doesn't fit. ``contrib=True`` models the pred_contrib (TreeSHAP)
+    dispatch — path-table pack + pweight working set — instead of
+    plain traversal."""
     kw = dict(num_rows=num_rows, num_features=num_features,
               num_trees=num_trees, num_leaves=num_leaves,
               num_class=num_class, chunk_rows=chunk_rows,
               pack_nbytes=pack_nbytes,
-              resident_pack_bytes=resident_pack_bytes)
+              resident_pack_bytes=resident_pack_bytes,
+              contrib=contrib, shap_pack_nbytes=shap_pack_nbytes)
     model = predict_memory_model(**kw)
     cap = capacity_bytes if capacity_bytes is not None \
         else device_capacity_bytes()

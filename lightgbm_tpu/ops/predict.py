@@ -134,6 +134,12 @@ class EnsemblePacker:
         self.trees_packed = 0      # cumulative (monotonic; test hook)
         self.full_repacks = 0
         self._cached = None        # device PackedEnsemble of _tokens
+        # TreeSHAP path-table pack (shap_update); cached independently
+        # of the traversal pack but under the same identity tokens
+        self._shap_tokens: List[tuple] = []
+        self._shap_key = None
+        self._shap_pack = None
+        self.shap_repacks = 0      # full path-table rebuilds (test hook)
 
     # -- internals -----------------------------------------------------
     def _alloc(self, cap_t: int, max_i: int, max_l: int, max_w: int):
@@ -193,6 +199,40 @@ class EnsemblePacker:
         # first element compares by IDENTITY, and the strong reference
         # pins the object so its id can't be recycled while tracked
         return (tr, getattr(tr, "pack_version", 0))
+
+    # -- TreeSHAP path decomposition -----------------------------------
+    def shap_update(self, trees: List, num_tree_per_iteration: int = 1,
+                    num_features: int = 1,
+                    chunk_rows: int = 4096) -> "ShapPack":
+        """Pack-time TreeSHAP path decomposition (GPUTreeShap-style):
+        enumerate every root->leaf path of every tree ONCE on the host,
+        merge repeated features along each path into unique elements
+        (interval-merged numeric thresholds, AND-merged categorical
+        bitsets, product zero-fractions), and ravel the result into
+        depth-padded [n_chunks, Pc, D] device tables the ops/shap.py
+        kernel consumes. Cached under the same (tree, pack_version)
+        identity tokens as the traversal pack, so DART renorm / refit /
+        rollback invalidate the path tables exactly like traversal."""
+        k = max(int(num_tree_per_iteration), 1)
+        f = max(int(num_features), 1)
+        tokens = [self._token(tr) for tr in trees]
+        key = (k, f, int(chunk_rows))
+        if (self._shap_pack is not None and key == self._shap_key
+                and tokens == self._shap_tokens):
+            return self._shap_pack
+        self._shap_pack = None
+        pack = _build_shap_pack(trees, k, f, int(chunk_rows))
+        self._shap_tokens = tokens
+        self._shap_key = key
+        self._shap_pack = pack
+        self.shap_repacks += 1
+        return pack
+
+    @property
+    def shap_nbytes(self) -> int:
+        """Host-side estimate of the path-table pack bytes (the device
+        tables mirror the same shapes; see nbytes for the 2x story)."""
+        return 0 if self._shap_pack is None else self._shap_pack.nbytes
 
     @property
     def nbytes(self) -> int:
@@ -286,6 +326,241 @@ def pack_ensemble(trees: List, num_tree_per_iteration: int = 1
     """Pack host Tree objects (tree.py) into exact-shape device tensors
     (one-shot; the serving path uses an owner-cached EnsemblePacker)."""
     return EnsemblePacker().update(trees, num_tree_per_iteration, pad=False)
+
+
+# ----------------------------------------------------------------------
+# TreeSHAP path decomposition (pack time, host side)
+#
+# The ops/shap.py kernel evaluates rows x paths: each root->leaf path
+# becomes one row of depth-padded element tables, where an "element" is
+# one UNIQUE feature on the path (the reference recursion's dedup/unwind
+# merges repeated features on the fly; we merge them once at pack time):
+#
+# - zero_fraction = product of taken-child cover ratios over the
+#   feature's occurrences (exactly the incoming_zero_fraction product
+#   the recursion accumulates through _unwind_path);
+# - one_fraction is 0/1 (a row either follows the whole path at this
+#   feature or not), so the per-row decision merges too: numeric
+#   occurrences collapse to an (lo, hi] interval in f32 (matching the
+#   device traversal's f32 threshold compare), categorical occurrences
+#   AND their direction-oriented bitset images into one merged bitset;
+# - missing routing merges as AND over "does the default direction
+#   follow this path here" (default_follows / oor_follows).
+#
+# Every path is padded to a uniform D slots with NEUTRAL elements
+# (one_fraction = zero_fraction = 1): extending a path by a (1,1)
+# element never changes any real element's unwound weight — the dummy
+# root element the reference recursion starts from is exactly such an
+# element — so padded paths stay bit-for-bit consistent with the
+# variable-depth recursion while giving the kernel static shapes.
+
+_SHAP_TABLE_FIELDS = (
+    "feature", "z", "z_inv", "lo", "hi", "no_lo", "default_follows",
+    "is_cat", "oor_follows", "mt", "cat_start", "cat_nwords", "segid")
+
+# working-set budget for the [B, Pc, D] kernel temporaries (pweights,
+# one-fractions, unwound totals, ...): Pc (the path-chunk width) is
+# sized so ~6 such tensors at the row-chunk cap fit in this budget
+_SHAP_BUDGET_BYTES = 128 << 20
+
+
+class ShapPack(NamedTuple):
+    """Depth-padded TreeSHAP path tables. P paths pad to n_chunks * Pc
+    rows; every path pads to D element slots (slot 0 is the dummy root
+    element). Neutral slots carry z = 1 and decide to one_fraction = 1,
+    so they contribute (1 - 1) * w = 0; their segid points at the trash
+    column num_class * (F + 1), which the kernel slices off."""
+    tables: tuple          # 13 [n_chunks, Pc, D] arrays (_SHAP_TABLE_FIELDS)
+    leaf_value: jax.Array  # [n_chunks, Pc] f32
+    cat_words: jax.Array   # [W] uint32 merged bitset words (>= 1 word)
+    bias: np.ndarray       # [K] f64 per-class expected values (host)
+    num_paths: int
+    depth: int             # D (element slots incl. dummy root)
+    path_chunk: int        # Pc
+    num_chunks: int
+    num_features: int
+    num_class: int
+    has_categorical: bool  # static: False elides the bitset ops
+    nbytes: int
+
+
+def _shap_child_count(tr, child: int) -> float:
+    return float(tr.leaf_count[~child]) if child < 0 else \
+        float(tr.internal_count[child])
+
+
+def _shap_paths_of_tree(tr):
+    """[(occurrences, leaf_value)] per root->leaf path, where an
+    occurrence is (node, went_left) in root->leaf order. Iterative so
+    deep trees can't blow the recursion limit."""
+    out = []
+    if tr.num_internal == 0:
+        return out
+    stack = [(0, [])]
+    while stack:
+        node, occs = stack.pop()
+        for went_left in (True, False):
+            child = int(tr.left_child[node] if went_left
+                        else tr.right_child[node])
+            occ2 = occs + [(node, went_left)]
+            if child < 0:
+                out.append((occ2, float(tr.leaf_value[~child])))
+            else:
+                stack.append((child, occ2))
+    return out
+
+
+def _shap_merge_elements(tr, occs):
+    """Merge one path's occurrences into unique per-feature elements
+    (first-occurrence order; order is irrelevant to the math)."""
+    elements = {}
+    order = []
+    for node, went_left in occs:
+        taken = int(tr.left_child[node] if went_left
+                    else tr.right_child[node])
+        count = int(tr.internal_count[node])
+        denom = float(count) if count > 0 else 1.0
+        feat = int(tr.split_feature[node])
+        dt = int(tr.decision_type[node])
+        el = elements.get(feat)
+        if el is None:
+            el = elements[feat] = dict(
+                feature=feat, z=1.0, lo=-np.inf, no_lo=True, hi=np.inf,
+                default_follows=True, is_cat=bool(dt & 1),
+                mt=(dt >> 2) & 3, oor_follows=True, cat_occ=[])
+            order.append(feat)
+        el["z"] *= _shap_child_count(tr, taken) / denom
+        default_left = bool(dt & _DEFAULT_LEFT_MASK)
+        el["default_follows"] &= (default_left == went_left)
+        if el["is_cat"]:
+            cat_idx = int(tr.threshold[node])
+            w_lo = tr.cat_boundaries[cat_idx]
+            w_hi = tr.cat_boundaries[cat_idx + 1]
+            words = np.asarray(tr.cat_threshold[w_lo:w_hi], np.uint32)
+            el["cat_occ"].append((words, went_left))
+            # values outside every occurrence's bitset range go right
+            el["oor_follows"] &= (not went_left)
+        else:
+            # f32 threshold compare, matching the device traversal pack
+            thr = float(np.float32(tr.threshold[node]))
+            if went_left:
+                el["hi"] = min(el["hi"], thr)
+            else:
+                el["lo"] = max(el["lo"], thr)
+                el["no_lo"] = False
+    return [elements[feat] for feat in order]
+
+
+def _shap_merge_cat_words(el) -> np.ndarray:
+    """AND the direction-oriented images of each occurrence's bitset:
+    a category follows the path iff it takes the recorded direction at
+    EVERY occurrence. Left-taken occurrences contribute their words
+    (in-set bit = goes left = follows), right-taken contribute the
+    complement; words beyond an occurrence's own range image to 0 (left
+    expects in-set, out-of-range is not) or all-ones (right)."""
+    width = max(len(words) for words, _ in el["cat_occ"])
+    merged = np.full(width, 0xFFFFFFFF, np.uint32)
+    for words, went_left in el["cat_occ"]:
+        if went_left:
+            img = np.zeros(width, np.uint32)
+            img[:len(words)] = words
+        else:
+            img = np.full(width, 0xFFFFFFFF, np.uint32)
+            img[:len(words)] = ~words
+        merged &= img
+    return merged
+
+
+def _pow2_floor(n: int) -> int:
+    return 1 << (max(int(n), 1).bit_length() - 1)
+
+
+def _shap_path_chunk(num_paths: int, depth: int, chunk_rows: int) -> int:
+    """Pc: paths per kernel invocation, sized so the [B, Pc, D] f32
+    working set (~6 tensors) at the row-chunk cap stays inside the
+    budget. Power of two so path counts bucket like row counts do."""
+    per_path = max(int(chunk_rows) * int(depth) * 4 * 6, 1)
+    pc = max(_pow2_floor(_SHAP_BUDGET_BYTES // per_path), 32)
+    return min(pc, _next_pow2(max(num_paths, 1)))
+
+
+def _build_shap_pack(trees: List, k: int, num_features: int,
+                     chunk_rows: int) -> ShapPack:
+    from ..shap import _expected_value
+    f = num_features
+    num_out = k * (f + 1)
+    bias = np.zeros(k, np.float64)
+    paths = []  # (class, elements, leaf_value)
+    for j, tr in enumerate(trees):
+        ki = j % k
+        bias[ki] += _expected_value(tr)
+        for occs, leaf_value in _shap_paths_of_tree(tr):
+            paths.append((ki, _shap_merge_elements(tr, occs), leaf_value))
+
+    num_paths = len(paths)
+    # D: dummy root slot + max unique elements, bucketed to a multiple
+    # of 4 (same recompile-bucketing story as the traversal depth)
+    depth = 1 + max((len(els) for _, els, _ in paths), default=0)
+    depth = max(-(-depth // 4) * 4, 4)
+    pc = _shap_path_chunk(num_paths, depth, chunk_rows)
+    p_pad = -(-max(num_paths, 1) // pc) * pc
+    n_chunks = p_pad // pc
+
+    arrs = dict(
+        feature=np.full((p_pad, depth), -1, np.int32),
+        z=np.ones((p_pad, depth), np.float32),
+        z_inv=np.ones((p_pad, depth), np.float32),
+        lo=np.zeros((p_pad, depth), np.float32),
+        hi=np.full((p_pad, depth), np.inf, np.float32),
+        no_lo=np.ones((p_pad, depth), np.bool_),
+        default_follows=np.zeros((p_pad, depth), np.bool_),
+        is_cat=np.zeros((p_pad, depth), np.bool_),
+        oor_follows=np.zeros((p_pad, depth), np.bool_),
+        mt=np.zeros((p_pad, depth), np.int32),
+        cat_start=np.zeros((p_pad, depth), np.int32),
+        cat_nwords=np.zeros((p_pad, depth), np.int32),
+        segid=np.full((p_pad, depth), num_out, np.int32),
+    )
+    leaf_value = np.zeros(p_pad, np.float32)
+    cat_words: List[np.ndarray] = []
+    cat_offset = 0
+    for p, (ki, els, lv) in enumerate(paths):
+        leaf_value[p] = lv
+        for d, el in enumerate(els, start=1):  # slot 0 = dummy root
+            z = el["z"]
+            arrs["feature"][p, d] = el["feature"]
+            arrs["z"][p, d] = z
+            arrs["z_inv"][p, d] = 1.0 / z if z > 0 else 0.0
+            arrs["segid"][p, d] = ki * (f + 1) + el["feature"]
+            arrs["mt"][p, d] = el["mt"]
+            arrs["default_follows"][p, d] = el["default_follows"]
+            if el["is_cat"]:
+                words = _shap_merge_cat_words(el)
+                arrs["is_cat"][p, d] = True
+                arrs["oor_follows"][p, d] = el["oor_follows"]
+                arrs["cat_start"][p, d] = cat_offset
+                arrs["cat_nwords"][p, d] = len(words)
+                cat_words.append(words)
+                cat_offset += len(words)
+            else:
+                arrs["lo"][p, d] = el["lo"] if not el["no_lo"] else 0.0
+                arrs["no_lo"][p, d] = el["no_lo"]
+                arrs["hi"][p, d] = el["hi"]
+
+    words_flat = (np.concatenate(cat_words) if cat_words
+                  else np.zeros(1, np.uint32))
+    nbytes = (sum(a.nbytes for a in arrs.values()) + leaf_value.nbytes
+              + words_flat.nbytes)
+    tables = tuple(
+        jnp.asarray(arrs[name].reshape(n_chunks, pc, depth))
+        for name in _SHAP_TABLE_FIELDS)
+    return ShapPack(
+        tables=tables,
+        leaf_value=jnp.asarray(leaf_value.reshape(n_chunks, pc)),
+        cat_words=jnp.asarray(words_flat), bias=bias,
+        num_paths=num_paths, depth=depth, path_chunk=pc,
+        num_chunks=n_chunks, num_features=f, num_class=k,
+        has_categorical=bool(cat_words), nbytes=int(nbytes))
 
 
 def _predict_leaf_one_tree(tree, x, max_depth: int):

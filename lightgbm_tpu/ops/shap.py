@@ -1,0 +1,250 @@
+"""Device (XLA) TreeSHAP — batched path-decomposed contributions.
+
+GPUTreeShap-style reformulation of the reference TreeSHAP recursion
+(src/io/tree.cpp TreeSHAP; Lundberg et al.): instead of walking each
+tree per row, the pack (ops/predict.py `EnsemblePacker.shap_update`)
+enumerates every root->leaf path once on the host into depth-padded
+unique-element tables, and the kernel evaluates rows x paths with fully
+vectorized permutation-weight recurrences:
+
+- **extend** runs once per element slot over the whole [B, Pc, D]
+  pweight tensor (the python loop over D is static and unrolls into the
+  XLA program);
+- the **unwound sum** — the reference computes it per element by
+  re-walking the pweights — is evaluated for ALL D elements
+  simultaneously: each element carries its own (one, zero) fractions,
+  so one pass over j = D-2..0 yields every element's weight at once;
+- per-element phi = w * (one - zero) * leaf_value scatter-adds into the
+  [B, K * (F + 1)] output via a precomputed segment-id table (neutral
+  padding slots target a trash column that is sliced off).
+
+Paths stream through the kernel in fixed [Pc, D] chunks via an
+in-program `fori_loop` over the stacked chunk axis, so the working set
+stays bounded by the pack-time budget while the whole ensemble remains
+ONE program — the same shape-stability story as the traversal engine:
+row chunks bucket through `_row_bucket`, so steady-state serving never
+recompiles (assertable through `recompiles(SHAP_TRACE_TAG)`).
+
+One-fractions are 0/1 per (row, element) — a row either follows the
+whole path at that feature or not — which is what lets the reference's
+hot/cold recursion collapse into a closed-form per-path evaluation.
+Per-row results are independent of the row block (row padding is pure
+garbage rows that are sliced off), so serve-side micro-batch coalescing
+returns bit-identical slices.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import List
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..obs.metrics import global_metrics
+from ..obs.trace import global_tracer
+from .predict import (ShapPack, _get_packer, _next_pow2)
+
+# shap program recompile tag (tests assert row/path chunk-shape
+# stability through global_metrics.recompiles(SHAP_TRACE_TAG))
+SHAP_TRACE_TAG = "shap/contrib"
+
+# per-row working set scales with paths x depth, so the row chunk is
+# capped well below the traversal engine's default 1M-row chunks
+MAX_CHUNK_ROWS = 4096
+
+
+def shap_row_bucket(rows: int, chunk: int) -> int:
+    """Pad target for a chunk of `rows`: pure power-of-two, capped at
+    the (small) shap chunk. The traversal engine's grain-based
+    `_row_bucket` would emit chunk/16 multiples here — at a 4096-row
+    cap that's a 16-shape set the pow2 warm ladder doesn't cover; pow2
+    keeps the compiled set at <= 9 shapes and the worst-case tail waste
+    at 2x of an already-small chunk."""
+    return min(_next_pow2(max(int(rows), 16)), max(int(chunk), 16))
+
+
+def _one_fractions(tbl: dict, cat_words: jax.Array, x: jax.Array,
+                   has_cat: bool) -> jax.Array:
+    """[B, Pc, D] bool: does row b follow the whole path p at element
+    slot d? Mirrors the device traversal's decision math
+    (predict.py predict_leaves_all) against the pack-time merged
+    interval / bitset / missing-routing tables."""
+    fs = jnp.clip(tbl["feature"], 0, x.shape[1] - 1)
+    v = x[:, fs]                       # [B, Pc, D]
+    isnan = jnp.isnan(v)
+    v0 = jnp.where(isnan, jnp.float32(0), v)
+    mt = tbl["mt"]
+    use_default = (isnan & (mt == 2)) | \
+        ((mt == 1) & (isnan | (jnp.abs(v0) <= 1e-35)))
+    # merged numeric interval: lo < v <= hi (no_lo elides the lower
+    # bound so v = -inf can't falsely fail `v > -inf`)
+    o_num = jnp.where(use_default, tbl["default_follows"],
+                      (tbl["no_lo"] | (v0 > tbl["lo"])) & (v0 <= tbl["hi"]))
+    if not has_cat:
+        return o_num
+    v_int = v0.astype(jnp.int32)
+    widx = jnp.clip(tbl["cat_start"] + v_int // 32, 0,
+                    cat_words.shape[0] - 1)
+    word = cat_words[widx]
+    in_range = (~isnan) & (v0 >= 0) & (v_int // 32 < tbl["cat_nwords"])
+    bit = (word >> (v_int % 32).astype(jnp.uint32)) & 1 > 0
+    o_cat = jnp.where(in_range, bit, tbl["oor_follows"])
+    return jnp.where(tbl["is_cat"], o_cat, o_num)
+
+
+def _contrib_chunk(tbl: dict, leaf_value: jax.Array, cat_words: jax.Array,
+                   x: jax.Array, num_out: int, has_cat: bool) -> jax.Array:
+    """One [Pc, D] path chunk -> [B, num_out + 1] contributions (last
+    column is the neutral-slot trash segment)."""
+    b = x.shape[0]
+    pc, depth = tbl["z"].shape
+    o = _one_fractions(tbl, cat_words, x, has_cat)
+    o_f = o.astype(jnp.float32)
+    z = tbl["z"][None]                 # [1, Pc, D]
+    z_inv = tbl["z_inv"][None]
+
+    # extend: pw[k] <- z_u*pw[k]*(u-k)/(u+1) + o_u*pw[k-1]*k/(u+1),
+    # exactly _extend_path's recurrence vectorized over (rows, paths).
+    # Entries past the current element count stay 0, so the negative
+    # (u-k) coefficients beyond u never see non-zero weight.
+    pw = jnp.zeros((b, pc, depth), jnp.float32).at[:, :, 0].set(1.0)
+    karr = np.arange(depth, dtype=np.float32)
+    for u in range(1, depth):
+        c1 = jnp.asarray((u - karr) / (u + 1.0))
+        c2 = jnp.asarray(karr / (u + 1.0))
+        shifted = jnp.concatenate(
+            [jnp.zeros((b, pc, 1), jnp.float32), pw[:, :, :-1]], axis=-1)
+        pw = (tbl["z"][:, u][None, :, None] * pw * c1
+              + o_f[:, :, u][:, :, None] * shifted * c2)
+
+    # unwound sum for ALL elements at once (_unwound_path_sum with
+    # U = D-1): each element d uses its own (o, z); one_fraction is
+    # 0/1, so the reference's `one != 0` branch is a where() select.
+    u_top = depth - 1
+    total = jnp.zeros((b, pc, depth), jnp.float32)
+    next_one = jnp.broadcast_to(pw[:, :, u_top:u_top + 1],
+                                (b, pc, depth))
+    for j in range(u_top - 1, -1, -1):
+        pwj = pw[:, :, j:j + 1]
+        tmp = next_one * ((u_top + 1.0) / (j + 1.0))
+        total_if_one = total + tmp
+        next_if_one = pwj - tmp * z * ((u_top - j) / (u_top + 1.0))
+        total_if_zero = total + pwj * ((u_top + 1.0) / (u_top - j)) * z_inv
+        total = jnp.where(o, total_if_one, total_if_zero)
+        next_one = jnp.where(o, next_if_one, next_one)
+
+    # phi = w * (one - zero) * leaf_value; neutral slots have
+    # one = zero = 1, so they contribute exactly 0 (and their segid
+    # targets the trash column anyway)
+    contrib = total * (o_f - z) * leaf_value[None, :, None]
+    seg = tbl["segid"].reshape(-1)
+    return jnp.zeros((b, num_out + 1), jnp.float32).at[:, seg].add(
+        contrib.reshape(b, -1))
+
+
+def contrib_run(num_out: int, has_cat: bool):
+    """The traceable program body over (13 stacked path tables,
+    leaf_value, cat_words, x) -> [B, num_out] f32 contributions —
+    shared by the jitted streaming path below and the serve-side AOT
+    explain ladder (serve/lowlat.py). The path-chunk axis streams
+    through an in-program fori_loop so the working set stays at one
+    [B, Pc, D] chunk while the whole pack remains a single program;
+    accumulation order over chunks is fixed, so outputs are
+    deterministic and independent of the row-block size."""
+    from .predict import _SHAP_TABLE_FIELDS
+
+    def run(*args):
+        tables = args[:len(_SHAP_TABLE_FIELDS)]
+        leaf_value, cat_words, x = args[len(_SHAP_TABLE_FIELDS):]
+        b = x.shape[0]
+        n_chunks = leaf_value.shape[0]
+
+        def body(i, acc):
+            tbl = {name: lax.dynamic_index_in_dim(a, i, keepdims=False)
+                   for name, a in zip(_SHAP_TABLE_FIELDS, tables)}
+            lv = lax.dynamic_index_in_dim(leaf_value, i, keepdims=False)
+            return acc + _contrib_chunk(tbl, lv, cat_words, x,
+                                        num_out, has_cat)
+
+        out = lax.fori_loop(0, n_chunks, body,
+                            jnp.zeros((b, num_out + 1), jnp.float32))
+        return out[:, :num_out]
+
+    return run
+
+
+@functools.lru_cache(maxsize=32)
+def _contrib_program(num_out: int, has_cat: bool):
+    from ..obs import xla as obs_xla
+    return obs_xla.instrumented_jit(SHAP_TRACE_TAG,
+                                    contrib_run(num_out, has_cat),
+                                    phase="predict")
+
+
+def shap_program_args(pack: ShapPack) -> tuple:
+    """The packed operand tuple `_contrib_program` expects before x."""
+    return pack.tables + (pack.leaf_value, pack.cat_words)
+
+
+def contrib_program_for(pack: ShapPack):
+    num_out = pack.num_class * (pack.num_features + 1)
+    return _contrib_program(num_out, pack.has_categorical)
+
+
+def add_bias(out: np.ndarray, pack: ShapPack) -> np.ndarray:
+    """Host-side f64 bias add: per-class expected value into the last
+    slot of each class block (matches the reference accumulating
+    _expected_value into out[:, ki, -1])."""
+    f = pack.num_features
+    for ki in range(pack.num_class):
+        out[:, ki * (f + 1) + f] += pack.bias[ki]
+    return out
+
+
+def shap_contrib_cached(owner, trees: List, num_tree_per_iteration: int,
+                        data: np.ndarray, num_features: int, cache_key,
+                        chunk: int = 1 << 20) -> np.ndarray:
+    """[N, K * (F + 1)] SHAP contributions through the packed path
+    tables — the device analog of shap._contrib_over_trees. The path
+    pack is cached on the SAME owner packers the traversal engine uses
+    (`_get_packer(owner, cache_key)`), so identity-token invalidation
+    (DART renorm, refit, rollback) covers both packs at once. Rows
+    stream in bucketed chunks with the double-buffered feed; the bias
+    column is added host-side in f64."""
+    k = max(int(num_tree_per_iteration), 1)
+    f = max(int(num_features), 1)
+    chunk = max(1, min(int(chunk), MAX_CHUNK_ROWS))
+    packer = _get_packer(owner, cache_key)
+    with global_tracer.span("shap/pack"):
+        pack = packer.shap_update(trees, k, f, chunk_rows=chunk)
+    owner._packed_key = cache_key
+    n = data.shape[0]
+    num_out = k * (f + 1)
+    out = np.zeros((n, num_out), np.float64)
+    if n and pack.num_paths:
+        prog = contrib_program_for(pack)
+        args = shap_program_args(pack)
+        bounds = [(lo, min(lo + chunk, n)) for lo in range(0, n, chunk)]
+
+        def stage(lo, hi):
+            rows = hi - lo
+            b = shap_row_bucket(rows, chunk)
+            xb = np.zeros((b, data.shape[1]), np.float32)
+            xb[:rows] = data[lo:hi]
+            return jax.device_put(xb), lo, rows
+
+        t0 = time.perf_counter()
+        with global_tracer.span("shap/contrib"):
+            from ..io.streaming import double_buffered
+            parts = []
+            for dev, lo, rows in double_buffered(bounds,
+                                                 lambda bd: stage(*bd)):
+                parts.append((prog(*args, dev), lo, rows))
+            for y, lo, rows in parts:
+                out[lo:lo + rows] = np.asarray(y, np.float64)[:rows]
+        global_metrics.note_predict(n, time.perf_counter() - t0)
+    return add_bias(out, pack)

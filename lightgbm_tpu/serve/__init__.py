@@ -12,7 +12,9 @@ small concurrent requests. This package turns one into the other:
   calling ``predict`` directly, because row traversal is independent
   per row and the per-row f32 accumulation order never changes).
 - ``lowlat``    — the dedicated B<=64 path: per-model AOT-compiled
-  traversal executables that bypass the batch machinery entirely.
+  traversal executables that bypass the batch machinery entirely
+  (plus the matching ``LowLatencyExplainer`` ladder for the
+  SHAP-contribution ``explain`` route).
 - ``artifacts`` — serialized AOT executables on disk: a replica
   restart or an LRU re-admission warms the lowlat ladder from the
   artifact store in milliseconds instead of recompiling (fingerprint-
@@ -30,7 +32,8 @@ small concurrent requests. This package turns one into the other:
 from .artifacts import ArtifactStore, serialize_available  # noqa: F401
 from .registry import ModelRegistry, ServedModel  # noqa: F401
 from .batcher import MicroBatcher  # noqa: F401
-from .lowlat import SERVE_LOWLAT_TAG, LowLatencyPredictor  # noqa: F401
+from .lowlat import (SERVE_EXPLAIN_TAG, SERVE_LOWLAT_TAG,  # noqa: F401
+                     LowLatencyExplainer, LowLatencyPredictor)
 from .server import (ModelServer, registry_from_config, replay,  # noqa: F401
                      serve_file, server_from_config)
 from .fleet import (FleetRouter, HTTPReplica,  # noqa: F401
@@ -41,6 +44,7 @@ __all__ = [
     "ArtifactStore", "serialize_available",
     "ModelRegistry", "ServedModel", "MicroBatcher",
     "LowLatencyPredictor", "SERVE_LOWLAT_TAG",
+    "LowLatencyExplainer", "SERVE_EXPLAIN_TAG",
     "ModelServer", "replay", "serve_file",
     "registry_from_config", "server_from_config",
     "FleetRouter", "HTTPReplica", "InProcessReplica",

@@ -47,11 +47,15 @@ _batch_ids = itertools.count(1)
 class MicroBatcher:
     def __init__(self, predict_fn: Callable[[np.ndarray], np.ndarray],
                  max_batch_rows: int = 8192, max_wait_s: float = 0.002,
-                 executor=None):
+                 executor=None, counter_prefix: str = "serve"):
         self._predict_fn = predict_fn
         self.max_batch_rows = max(int(max_batch_rows), 1)
         self.max_wait_s = max(float(max_wait_s), 0.0)
         self._executor = executor
+        # counter family: "serve" for raw-score batches, "explain" for
+        # the SHAP-contribution batchers (server.explain) — the flush
+        # bookkeeping below is otherwise identical
+        self.counter_prefix = str(counter_prefix)
         # (x, future, trace, deadline, arrival_t0) per pending request
         self._pending: List[Tuple[np.ndarray, asyncio.Future, object,
                                   float, float]] = []
@@ -132,13 +136,14 @@ class MicroBatcher:
 
         xs = [x for x, _, _, _, _ in batch]
         xcat = np.concatenate(xs, axis=0) if len(xs) > 1 else xs[0]
-        global_metrics.inc_counter("serve/batches")
-        global_metrics.inc_counter("serve/batched_rows", xcat.shape[0])
+        pre = self.counter_prefix
+        global_metrics.inc_counter(f"{pre}/batches")
+        global_metrics.inc_counter(f"{pre}/batched_rows", xcat.shape[0])
         if len(batch) > 1:
-            global_metrics.inc_counter("serve/coalesced_requests",
+            global_metrics.inc_counter(f"{pre}/coalesced_requests",
                                        len(batch))
         global_metrics.note_latency(
-            "serve/batch_wait", time.perf_counter() - self._oldest_t0)
+            f"{pre}/batch_wait", time.perf_counter() - self._oldest_t0)
 
         traces = [tr for _, _, tr, _, _ in batch if tr is not None]
         if traces:

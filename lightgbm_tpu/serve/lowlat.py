@@ -43,6 +43,51 @@ from .artifacts import backend_fingerprint, open_store, trees_digest
 # the compiler.
 SERVE_LOWLAT_TAG = "serve/lowlat"
 
+# the explain route's AOT twin (LowLatencyExplainer); steady-state
+# stability is asserted through recompiles(SERVE_EXPLAIN_TAG)
+SERVE_EXPLAIN_TAG = "serve/explain_lowlat"
+
+
+def _compile_for_store(store, lowered):
+    """``lowered.compile()``, bypassing the persistent XLA compile
+    cache when an artifact store will serialize the result: on
+    affected jaxlibs an executable that was itself DESERIALIZED
+    from the disk cache re-serializes incompletely ("Symbols not
+    found" on a later load), so an exportable executable must come
+    from a fresh backend compile. The artifact store IS this
+    ladder's persistent cache, so the bypass costs one fresh
+    compile exactly where a serialized artifact replaces the disk
+    cache anyway. No store => plain (cache-served) compile.
+
+    Mechanics: clearing the cache dir alone is NOT enough — jax
+    memoizes its "cache in use" verdict process-wide
+    (compilation_cache._cache_checked), so the verdict is reset
+    around the un-cached compile and again after the dir is
+    restored (the next ordinary compile then re-initializes the
+    cache lazily). Internal-API use is fully guarded: if it drifts,
+    we fall back to the cache-served compile and rely on the
+    store's save-time validation to refuse a bad artifact."""
+    if store is None:
+        return lowered.compile()
+    import jax as _jax
+    try:
+        from jax._src import compilation_cache as _cc
+        prev = _jax.config.jax_compilation_cache_dir
+        if prev is None:
+            return lowered.compile()
+        _cc.reset_cache()
+        _jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        return lowered.compile()
+    try:
+        return lowered.compile()
+    finally:
+        try:
+            _jax.config.update("jax_compilation_cache_dir", prev)
+            _cc.reset_cache()
+        except Exception:
+            pass
+
 
 class LowLatencyPredictor:
     """Per-model AOT-compiled small-batch predictor.
@@ -113,44 +158,7 @@ class LowLatencyPredictor:
                     width=int(num_features))
 
     def _compile_for_store(self, lowered):
-        """``lowered.compile()``, bypassing the persistent XLA compile
-        cache when an artifact store will serialize the result: on
-        affected jaxlibs an executable that was itself DESERIALIZED
-        from the disk cache re-serializes incompletely ("Symbols not
-        found" on a later load), so an exportable executable must come
-        from a fresh backend compile. The artifact store IS this
-        ladder's persistent cache, so the bypass costs one fresh
-        compile exactly where a serialized artifact replaces the disk
-        cache anyway. No store => plain (cache-served) compile.
-
-        Mechanics: clearing the cache dir alone is NOT enough — jax
-        memoizes its "cache in use" verdict process-wide
-        (compilation_cache._cache_checked), so the verdict is reset
-        around the un-cached compile and again after the dir is
-        restored (the next ordinary compile then re-initializes the
-        cache lazily). Internal-API use is fully guarded: if it drifts,
-        we fall back to the cache-served compile and rely on the
-        store's save-time validation to refuse a bad artifact."""
-        if self._store is None:
-            return lowered.compile()
-        import jax as _jax
-        try:
-            from jax._src import compilation_cache as _cc
-            prev = _jax.config.jax_compilation_cache_dir
-            if prev is None:
-                return lowered.compile()
-            _cc.reset_cache()
-            _jax.config.update("jax_compilation_cache_dir", None)
-        except Exception:
-            return lowered.compile()
-        try:
-            return lowered.compile()
-        finally:
-            try:
-                _jax.config.update("jax_compilation_cache_dir", prev)
-                _cc.reset_cache()
-            except Exception:
-                pass
+        return _compile_for_store(self._store, lowered)
 
     def _program(self, rows_bucket: int, num_features: int):
         key = (rows_bucket, num_features)
@@ -256,4 +264,156 @@ class LowLatencyPredictor:
         dt = time.perf_counter() - t0
         global_metrics.note_predict(rows, dt)
         global_metrics.note_latency(SERVE_LOWLAT_TAG, dt)
+        return out
+
+
+class LowLatencyExplainer:
+    """Per-model AOT-compiled small-batch TreeSHAP explainer — the
+    `explain` route's twin of LowLatencyPredictor.
+
+    Packs the path-decomposed tables (ops/predict.py shap_update) once
+    and AOT-compiles one executable per (row-bucket, feature-width) over
+    the whole pack, so small explanation requests ride the same
+    zero-steady-state-recompile ladder as predictions. Outputs are
+    bit-identical to the streaming device path for the same rows: the
+    program body is shared (ops/shap.py contrib_run), per-row results
+    are row-block independent, and both paths bucket rows to the same
+    powers of two."""
+
+    def __init__(self, trees: List, num_tree_per_iteration: int = 1,
+                 max_rows: int = 64, artifact_dir: str = "",
+                 pack_chunk_rows: int = 0):
+        from ..ops.predict import EnsemblePacker
+        from ..ops.shap import MAX_CHUNK_ROWS
+        self._trees = trees
+        self._k = max(int(num_tree_per_iteration), 1)
+        self.max_rows = max(int(max_rows), 1)
+        # the pack's path-chunk layout MUST match the streaming path's
+        # (same effective row-chunk -> same Pc): the in-program chunk
+        # accumulation order is part of the f32 bits, and the bit-parity
+        # contract says lowlat == batched == direct on the same rows
+        self.pack_chunk_rows = max(1, min(
+            int(pack_chunk_rows) or MAX_CHUNK_ROWS, MAX_CHUNK_ROWS))
+        self._packer = EnsemblePacker()
+        self._pack = None
+        self._compiled: Dict[Tuple[int, int], object] = {}
+        self._store = open_store(artifact_dir)
+        self._fingerprint = None
+
+    # ------------------------------------------------------------------
+    def _ensure_packed(self, num_features: int):
+        if self._pack is None or self._pack.num_features != num_features:
+            self._pack = self._packer.shap_update(
+                self._trees, self._k, num_features,
+                chunk_rows=self.pack_chunk_rows)
+            self._compiled.clear()
+            self._fingerprint = None
+        return self._pack
+
+    @property
+    def nbytes(self) -> int:
+        """Path-table bytes held by the pack (0 until first use)."""
+        return 0 if self._pack is None else self._pack.nbytes
+
+    def buckets(self) -> List[int]:
+        # floored at 16 like the streaming path's shap_row_bucket: both
+        # routes must run the IDENTICAL row bucket for the same request
+        # so the compiled program (and its f32 bits) is the same — tiny
+        # static batch sizes can lower differently under XLA
+        out = []
+        b = min(16, self.max_rows)
+        while b < self.max_rows:
+            out.append(b)
+            b <<= 1
+        out.append(self.max_rows)
+        return out
+
+    def bucket(self, rows: int) -> int:
+        return min(max(_next_pow2(max(rows, 1)), 16), self.max_rows)
+
+    def _operands(self) -> tuple:
+        from ..ops.shap import shap_program_args
+        return shap_program_args(self._pack)
+
+    def _artifact_key(self, rows_bucket: int, num_features: int) -> dict:
+        if self._fingerprint is None:
+            fp = backend_fingerprint()
+            fp["kind"] = "explain"
+            fp["pack_shapes"] = [[list(a.shape), str(a.dtype)]
+                                 for a in self._operands()]
+            fp["model_digest"] = trees_digest(self._trees, self._k)
+            fp["k"] = self._k
+            self._fingerprint = fp
+        return dict(self._fingerprint, bucket=int(rows_bucket),
+                    width=int(num_features))
+
+    def _program(self, rows_bucket: int, num_features: int):
+        key = (rows_bucket, num_features)
+        prog = self._compiled.get(key)
+        if prog is not None:
+            return prog
+        if self._store is not None:
+            prog = self._store.load(self._artifact_key(rows_bucket,
+                                                       num_features))
+            if prog is not None:
+                self._compiled[key] = prog
+                return prog
+        from ..ops.shap import contrib_run
+        pack = self._pack
+        num_out = pack.num_class * (pack.num_features + 1)
+        run = contrib_run(num_out, pack.has_categorical)
+        shapes = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                  for a in self._operands()]
+        shapes.append(jax.ShapeDtypeStruct(
+            (rows_bucket, num_features), jnp.float32))
+        t0 = time.perf_counter()
+        lowered = jax.jit(global_metrics.wrap_traced(SERVE_EXPLAIN_TAG, run)
+                          ).lower(*shapes)
+        t1 = time.perf_counter()
+        hits0 = global_xla.cache_hits() if global_xla.enabled else 0
+        prog = _compile_for_store(self._store, lowered)
+        if global_xla.enabled:
+            global_xla.note_compile(
+                SERVE_EXPLAIN_TAG, "serve",
+                f"{rows_bucket}x{num_features}",
+                time.perf_counter() - t1, prog, trace_s=t1 - t0,
+                cache_hit=global_xla.cache_hits() > hits0)
+        self._compiled[key] = prog
+        if self._store is not None:
+            self._store.save(self._artifact_key(rows_bucket,
+                                                num_features), prog)
+        return prog
+
+    def warm(self, num_features: int) -> int:
+        """Make every explain bucket resident (load-or-compile);
+        idempotent like the predictor's warm."""
+        self._ensure_packed(num_features)
+        for b in self.buckets():
+            self._program(b, num_features)
+        return len(self._compiled)
+
+    # ------------------------------------------------------------------
+    def __call__(self, data: np.ndarray) -> np.ndarray:
+        """[B, K * (F + 1)] f64 SHAP contributions for B <= max_rows
+        rows — the same bits shap_contrib_cached produces."""
+        from ..ops.shap import add_bias
+        x = np.asarray(data, np.float64)
+        if x.ndim == 1:
+            x = x.reshape(1, -1)
+        rows, f = x.shape
+        if rows > self.max_rows:
+            raise ValueError(f"low-latency explain takes <= "
+                             f"{self.max_rows} rows, got {rows} "
+                             "(use the batched path)")
+        pack = self._ensure_packed(f)
+        t0 = time.perf_counter()
+        b = self.bucket(rows)
+        xb = np.zeros((b, f), np.float32)
+        xb[:rows] = x
+        out = self._program(b, f)(*self._operands(), jnp.asarray(xb))
+        out = np.asarray(out, np.float64)[:rows]
+        out = add_bias(out, pack)
+        dt = time.perf_counter() - t0
+        global_metrics.note_predict(rows, dt)
+        global_metrics.note_latency(SERVE_EXPLAIN_TAG, dt)
         return out

@@ -30,12 +30,13 @@ from typing import List, Optional
 import numpy as np
 
 from ..obs.metrics import global_metrics
-from .lowlat import LowLatencyPredictor
+from .lowlat import LowLatencyExplainer, LowLatencyPredictor
 
 
 class ServedModel:
     """One registry entry: a loaded model plus its serving state (the
-    lazily-built low-latency predictor). Create via ModelRegistry.load."""
+    lazily-built low-latency predictor + explainer). Create via
+    ModelRegistry.load."""
 
     def __init__(self, name: str, model, lowlat_max_rows: int = 64,
                  artifact_dir: str = ""):
@@ -48,10 +49,14 @@ class ServedModel:
         # loads them back instead of recompiling
         self.artifact_dir = str(artifact_dir or "")
         self._lowlat: Optional[LowLatencyPredictor] = None
+        self._explainer: Optional[LowLatencyExplainer] = None
         # linear-tree leaves predict on host (the engine has no linear
-        # path) — such models always route through predict_raw
+        # path) — such models always route through predict_raw; they
+        # can't explain at all (pred_contrib raises the reference's
+        # linear-tree restriction), so the explain route shares the gate
         self.supports_lowlat = not any(
             getattr(t, "is_linear", False) for t in model.trees)
+        self.supports_explain = self.supports_lowlat
 
     # -- prediction entries (raw [B, K] float64) -----------------------
     def predict_raw(self, data: np.ndarray) -> np.ndarray:
@@ -62,6 +67,12 @@ class ServedModel:
     def lowlat_predict(self, data: np.ndarray) -> np.ndarray:
         """Raw scores through the AOT small-batch path (B <= 64-ish)."""
         return self.lowlat(data)
+
+    def explain_raw(self, data: np.ndarray) -> np.ndarray:
+        """[B, K * (F + 1)] SHAP contributions through the streaming
+        device kernel — the explain micro-batcher's dispatch function.
+        Bit-identical to Booster.predict(pred_contrib=True)."""
+        return self.model.predict_contrib(data)
 
     # -- serve dispatch twins: the ModelServer routes through these so
     # the deterministic fault plan (resilience/faults.py) can inject
@@ -80,6 +91,18 @@ class ServedModel:
             faults_mod.global_faults.check_serve_dispatch(self.name)
         return self.lowlat(data)
 
+    def dispatch_explain(self, data: np.ndarray) -> np.ndarray:
+        from ..resilience import faults as faults_mod
+        if faults_mod.global_faults.armed:
+            faults_mod.global_faults.check_serve_dispatch(self.name)
+        return self.model.predict_contrib(data)
+
+    def dispatch_lowlat_explain(self, data: np.ndarray) -> np.ndarray:
+        from ..resilience import faults as faults_mod
+        if faults_mod.global_faults.armed:
+            faults_mod.global_faults.check_serve_dispatch(self.name)
+        return self.explainer(data)
+
     @property
     def lowlat(self) -> LowLatencyPredictor:
         if self._lowlat is None:
@@ -91,16 +114,32 @@ class ServedModel:
                 artifact_dir=self.artifact_dir)
         return self._lowlat
 
+    @property
+    def explainer(self) -> LowLatencyExplainer:
+        if self._explainer is None:
+            self._explainer = LowLatencyExplainer(
+                self.model.trees,
+                num_tree_per_iteration=self.model.num_tree_per_iteration,
+                max_rows=self.lowlat_max_rows,
+                artifact_dir=self.artifact_dir,
+                # same effective row chunk as model.predict_contrib ->
+                # same path-chunk layout -> bit-identical contributions
+                pack_chunk_rows=int(self.model.predict_chunk or 0))
+        return self._explainer
+
     # -- pack accounting / eviction ------------------------------------
     def pack_bytes(self) -> int:
         """Resident packed-ensemble bytes for this model: host packer
-        arrays x2 (device tensors mirror the host shapes) plus the
-        low-latency path's device pack."""
+        arrays x2 (device tensors mirror the host shapes), the TreeSHAP
+        path tables x2 (same host/device mirror story), plus the
+        low-latency paths' device packs."""
         total = 0
         for packer in getattr(self.model, "_packers", {}).values():
-            total += 2 * packer.nbytes
+            total += 2 * packer.nbytes + 2 * packer.shap_nbytes
         if self._lowlat is not None:
             total += self._lowlat.nbytes
+        if self._explainer is not None:
+            total += self._explainer.nbytes
         return total
 
     def drop_packs(self) -> int:
@@ -111,6 +150,7 @@ class ServedModel:
         self.model._packed = None
         self.model._packed_key = None
         self._lowlat = None
+        self._explainer = None
         return released
 
 

@@ -110,18 +110,27 @@ class ModelServer:
         self._executor = ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="lgbm-serve")
         self._batchers: Dict[str, MicroBatcher] = {}
+        # SHAP-contribution requests coalesce separately: an explain
+        # batch must never ride a raw-score dispatch (different output
+        # widths), but both families share the one device executor
+        self._explain_batchers: Dict[str, MicroBatcher] = {}
         self._warming = 0  # warm() calls in flight (readiness gate)
         self._draining = False  # SIGTERM drain: no new admissions
         self._metrics_endpoint = None
 
     # ------------------------------------------------------------------
-    def _batcher(self, entry: ServedModel) -> MicroBatcher:
-        b = self._batchers.get(entry.name)
+    def _batcher(self, entry: ServedModel,
+                 kind: str = "predict") -> MicroBatcher:
+        explain = kind == "explain"
+        pool = self._explain_batchers if explain else self._batchers
+        b = pool.get(entry.name)
         if b is None or b._predict_fn.__self__ is not entry:
             # new or re-loaded entry: bind a fresh batcher to it
-            b = self._batchers[entry.name] = MicroBatcher(
-                entry.dispatch_raw, max_batch_rows=self.max_batch_rows,
-                max_wait_s=self.max_wait_s, executor=self._executor)
+            b = pool[entry.name] = MicroBatcher(
+                entry.dispatch_explain if explain else entry.dispatch_raw,
+                max_batch_rows=self.max_batch_rows,
+                max_wait_s=self.max_wait_s, executor=self._executor,
+                counter_prefix="explain" if explain else "serve")
         return b
 
     def _breaker(self, entry: ServedModel) -> CircuitBreaker:
@@ -148,6 +157,31 @@ class ModelServer:
         keep faulting trips its circuit breaker and fails fast until
         the half-open probe succeeds. Every event lands in the
         ``resilience/*`` obs counters (``lgbmtpu_resilience_*``)."""
+        return await self._serve(name, data, raw_score, "predict")
+
+    async def explain(self, name: str, data) -> np.ndarray:
+        """Serve one SHAP-explanation request: [B, K * (F + 1)]
+        contributions, bit-identical to
+        ``entry.model.predict_contrib(data)`` on the same rows — the
+        device kernel's per-row results don't depend on the row block,
+        so coalesced slices match direct calls exactly (asserted by
+        tools/check_shap.py). Same degradation contract as ``predict``
+        (deadline / admission shedding / retry / breaker), same single
+        device executor; small requests ride the AOT explain ladder
+        (``ServedModel.explainer``), larger ones coalesce in a separate
+        per-model explain batcher. Volume/latency land in the
+        ``explain/*`` counters and the ``explain/request`` reservoir
+        (``lgbmtpu_explain_*``). Contributions are raw-space by
+        definition, so there is no ``raw_score`` transform. Linear-tree
+        models reject with ``ValueError`` (the reference's
+        pred_contrib restriction)."""
+        return await self._serve(name, data, True, "explain")
+
+    async def _serve(self, name: str, data, raw_score: bool,
+                     kind: str) -> np.ndarray:
+        explain = kind == "explain"
+        pre = "explain" if explain else "serve"
+        event = "explain_request" if explain else "serve_request"
         t0 = time.perf_counter()
         if self._draining:
             # graceful-drain contract: a draining server sheds new
@@ -171,6 +205,14 @@ class ModelServer:
             raise ValueError(
                 f"request has {x.shape[1]} features but model "
                 f"'{name}' expects {need}")
+        if explain and not entry.supports_explain:
+            # mirror the reference restriction up front — a linear-tree
+            # model would only raise deep inside the host fallback and
+            # unfairly count against the circuit breaker
+            raise ValueError(
+                f"model '{name}' uses linear trees: pred_contrib "
+                "explanations are not supported (reference "
+                "restriction)")
         rows = int(x.shape[0])
         if self.max_queue_rows > 0 and self._queued_rows > 0 and \
                 self._queued_rows + rows > self.max_queue_rows:
@@ -199,8 +241,8 @@ class ModelServer:
         lowlat = (x.shape[0] <= min(self.lowlat_max_rows,
                                     entry.lowlat_max_rows)
                   and entry.supports_lowlat)
-        global_metrics.inc_counter("serve/lowlat_requests" if lowlat
-                                   else "serve/batched_requests")
+        global_metrics.inc_counter(f"{pre}/lowlat_requests" if lowlat
+                                   else f"{pre}/batched_requests")
         loop = asyncio.get_running_loop()
         # request-scoped tracing: one attribute check when the tracer is
         # off; otherwise the request gets a trace id and its queue/device
@@ -209,7 +251,7 @@ class ModelServer:
         self._queued_rows += rows
         try:
             raw = await self._dispatch_with_retry(entry, x, rt, deadline,
-                                                  br, loop, lowlat)
+                                                  br, loop, lowlat, kind)
         except (DeadlineExceeded, asyncio.CancelledError) as exc:
             # not a verdict on the model: a half-open PROBE that died
             # this way frees its slot so the breaker can probe again
@@ -217,7 +259,7 @@ class ModelServer:
             if br is not None and probe_held:
                 br.release_probe()
             if global_flightrec.armed:
-                global_flightrec.record("serve_request", model=name,
+                global_flightrec.record(event, model=name,
                                         rows=rows, ok=False,
                                         error=type(exc).__name__)
             raise
@@ -226,23 +268,28 @@ class ModelServer:
             # black box keeps the outcome even though the error routes
             # back to the caller
             if global_flightrec.armed:
-                global_flightrec.record("serve_request", model=name,
+                global_flightrec.record(event, model=name,
                                         rows=rows, ok=False,
                                         error=type(exc).__name__)
             raise
         finally:
             self._queued_rows -= rows
-        out = raw[:, 0] if raw.shape[1] == 1 else raw
-        if not raw_score:
-            from ..model_io import transform_raw
-            out = transform_raw(entry.model.objective_str, out)
-        global_metrics.inc_counter("serve/requests")
-        global_metrics.inc_counter("serve/rows", x.shape[0])
-        global_metrics.note_latency("serve/request",
+        if explain:
+            # contributions are raw-space by definition: no squeeze
+            # ([B, F+1] at minimum), no objective transform
+            out = raw
+        else:
+            out = raw[:, 0] if raw.shape[1] == 1 else raw
+            if not raw_score:
+                from ..model_io import transform_raw
+                out = transform_raw(entry.model.objective_str, out)
+        global_metrics.inc_counter(f"{pre}/requests")
+        global_metrics.inc_counter(f"{pre}/rows", x.shape[0])
+        global_metrics.note_latency(f"{pre}/request",
                                     time.perf_counter() - t0)
         if global_flightrec.armed:
             global_flightrec.record(
-                "serve_request", model=name, rows=rows, ok=True,
+                event, model=name, rows=rows, ok=True,
                 lowlat=bool(lowlat),
                 latency_ms=round((time.perf_counter() - t0) * 1e3, 3))
         if rt is not None:
@@ -253,15 +300,16 @@ class ModelServer:
             if rt.batch_id is not None:
                 args["batch_id"] = rt.batch_id
             global_tracer.add_complete_span(
-                "serve/request", rt.t0_ns,
-                time.perf_counter_ns() - rt.t0_ns, args=args)
+                "serve/explain" if explain else "serve/request",
+                rt.t0_ns, time.perf_counter_ns() - rt.t0_ns, args=args)
         self.registry.evict_to_budget()
         return out
 
     # ------------------------------------------------------------------
     async def _dispatch_with_retry(self, entry: ServedModel,
                                    x: np.ndarray, rt, deadline: float,
-                                   br, loop, lowlat: bool) -> np.ndarray:
+                                   br, loop, lowlat: bool,
+                                   kind: str = "predict") -> np.ndarray:
         """Route one request (lowlat / batched) with exponential-backoff
         retries of transient faults. Deadline and cancellation pass
         straight through (load conditions, not model faults); any other
@@ -282,7 +330,7 @@ class ModelServer:
                     - (deadline - self.deadline_s))
             try:
                 out = await self._dispatch(entry, x, rt, deadline, loop,
-                                           lowlat)
+                                           lowlat, kind)
             except (DeadlineExceeded, asyncio.CancelledError):
                 raise
             except TransientServeError as exc:
@@ -305,16 +353,19 @@ class ModelServer:
         raise last_exc
 
     async def _dispatch(self, entry: ServedModel, x: np.ndarray, rt,
-                        deadline: float, loop,
-                        lowlat: bool) -> np.ndarray:
-        # the route was decided (and counted) once in predict(): the
+                        deadline: float, loop, lowlat: bool,
+                        kind: str = "predict") -> np.ndarray:
+        # the route was decided (and counted) once in _serve(): the
         # server-level threshold can only lower the routing cut below
         # the per-entry AOT limit, never push requests past it
+        explain = kind == "explain"
         if lowlat:
             if rt is not None:
                 rt.path = "lowlat"
+            fn = (entry.dispatch_lowlat_explain if explain
+                  else entry.dispatch_lowlat)
 
-            def run_lowlat(x=x, entry=entry, rt=rt):
+            def run_lowlat(x=x, fn=fn, rt=rt):
                 t_dev = time.perf_counter_ns()
                 if deadline and time.perf_counter() > deadline:
                     # the executor queue ate the whole budget: fail
@@ -327,7 +378,7 @@ class ModelServer:
                         "executor")
                 if rt is not None:
                     rt.queue_ns = t_dev - rt.t0_ns  # executor queue wait
-                out = entry.dispatch_lowlat(x)
+                out = fn(x)
                 if rt is not None:
                     rt.device_ns = time.perf_counter_ns() - t_dev
                 return out
@@ -335,16 +386,24 @@ class ModelServer:
             return await loop.run_in_executor(self._executor, run_lowlat)
         if rt is not None:
             rt.path = "batched"
-        return await self._batcher(entry).submit(x, trace=rt,
-                                                 deadline=deadline)
+        return await self._batcher(entry, kind).submit(x, trace=rt,
+                                                       deadline=deadline)
 
     # ------------------------------------------------------------------
-    def warm(self, name: str, num_features: int) -> None:
+    def warm(self, name: str, num_features: int,
+             explain: bool = False) -> None:
         """Precompile the serving program set for `name`: the low-
         latency bucket ladder plus the engine's power-of-two batch
         buckets up to max_batch_rows. After this, steady-state traffic
         of any request mix runs with ZERO recompiles (asserted by
         tools/check_serve.py through the obs recompile counters).
+
+        With ``explain=True`` the SHAP program set warms too: the AOT
+        explain ladder (``ServedModel.explainer``) plus the streaming
+        contribution program's batch buckets — opt-in because the
+        explain ladder doubles warm-time compiles and most servers
+        never take explain traffic (tools/check_shap.py asserts the
+        zero-recompile story for the explain route).
 
         While a warm() is in flight the server reports NOT ready
         (``/readyz`` 503) — a rollout that gates traffic on readiness
@@ -354,10 +413,14 @@ class ModelServer:
             entry = self.registry.get(name)
             if entry.supports_lowlat:
                 entry.lowlat.warm(num_features)
+            if explain and entry.supports_explain:
+                entry.explainer.warm(num_features)
             # engine buckets floor at 16 rows (ops/predict._row_bucket)
             b = 16
             while b < 2 * self.max_batch_rows:
                 entry.predict_raw(np.zeros((b, num_features)))
+                if explain and entry.supports_explain:
+                    entry.explain_raw(np.zeros((b, num_features)))
                 b <<= 1
         finally:
             self._warming -= 1
@@ -393,8 +456,9 @@ class ModelServer:
         self.begin_drain()
         deadline = time.perf_counter() + max(float(timeout_s), 0.0)
         while self._queued_rows > 0 and time.perf_counter() < deadline:
-            for b in self._batchers.values():
-                b.flush()  # don't make stragglers wait out max_wait_ms
+            for pool in (self._batchers, self._explain_batchers):
+                for b in pool.values():
+                    b.flush()  # don't make stragglers wait max_wait_ms
             await asyncio.sleep(0.002)
         drained = self._queued_rows == 0
         if global_flightrec.armed:
@@ -438,14 +502,16 @@ class ModelServer:
                 "serve/batch_wait"),
             "counters": {k: v for k, v in
                          sorted(global_metrics.counters.items())
-                         if k.startswith(("serve/", "resilience/"))},
+                         if k.startswith(("serve/", "explain/",
+                                          "resilience/"))},
             "pack_bytes": self.registry.pack_bytes(),
         }
 
     async def close(self) -> None:
         """Flush pending batches and release the device executor."""
-        for b in self._batchers.values():
-            b.flush()
+        for pool in (self._batchers, self._explain_batchers):
+            for b in pool.values():
+                b.flush()
         self._executor.shutdown(wait=True)
         if self._metrics_endpoint is not None:
             self._metrics_endpoint.close()

@@ -7,9 +7,18 @@ Individualized Feature Attribution for Tree Ensembles".)
 Exact path-dependent TreeSHAP over the host tree arrays. Output layout
 matches the reference: [N, (F+1) * K] with the last slot per class being
 the expected value (bias).
+
+`pred_contrib` dispatches to the batched device kernel (ops/shap.py:
+pack-time path decomposition + vectorized permutation weights) unless
+the `tpu_shap` knob says off or the model has linear-tree leaves; the
+recursion below is retained as the parity oracle and the fallback, with
+the same chunked dispatch and `note_predict` accounting as the main
+predict path so even the fallback is observable and memory-bounded.
 """
 
 from __future__ import annotations
+
+import time
 
 import numpy as np
 
@@ -147,44 +156,101 @@ def _expected_value(tree) -> float:
 
 def _contrib_over_trees(tree_of, n_iters: int, k: int, data: np.ndarray,
                         num_feat: int, start_iteration: int,
-                        num_iteration: int) -> np.ndarray:
-    """Shared TreeSHAP accumulation. tree_of(it, ki) -> Tree."""
+                        num_iteration: int,
+                        chunk: int = 1 << 20) -> np.ndarray:
+    """Shared TreeSHAP accumulation (host recursion; the device oracle).
+    tree_of(it, ki) -> Tree. Rows dispatch in `chunk`-sized blocks with
+    the same note_predict accounting as the device engines."""
     if n_iters > 0 and k > 0 and getattr(tree_of(0, 0), "is_linear", False):
         raise ValueError(
             "pred_contrib is not supported for linear trees (the "
             "reference raises the same restriction)")
     n = data.shape[0]
+    chunk = max(int(chunk or (1 << 20)), 1)
     out = np.zeros((n, k, num_feat + 1))
     end = n_iters if num_iteration < 0 else min(
         n_iters, start_iteration + num_iteration)
-    for it in range(start_iteration, end):
-        for ki in range(k):
+    window = [(it, ki) for it in range(start_iteration, end)
+              for ki in range(k)]
+    t0 = time.perf_counter()
+    for it, ki in window:
+        out[:, ki, -1] += _expected_value(tree_of(it, ki))
+    for lo in range(0, n, chunk):
+        hi = min(lo + chunk, n)
+        for it, ki in window:
             tree = tree_of(it, ki)
-            out[:, ki, -1] += _expected_value(tree)
             if tree.num_internal == 0:
                 continue
-            for r in range(n):
+            for r in range(lo, hi):
                 phi = np.zeros(num_feat + 1)
                 _tree_shap(tree, data[r], phi, 0, 0, [], 1.0, 1.0, -1)
                 out[r, ki, :-1] += phi[:-1]
+    if n:
+        from .obs.metrics import global_metrics
+        global_metrics.note_predict(n, time.perf_counter() - t0)
     return out.reshape(n, k * (num_feat + 1)) if k > 1 else \
         out.reshape(n, num_feat + 1)
 
 
+def _use_device(tpu_shap, trees) -> bool:
+    """Route to the batched device kernel unless the knob says off or
+    the model carries linear-tree leaves (the host path owns those —
+    it raises the reference's linear-tree restriction)."""
+    mode = str(tpu_shap if tpu_shap is not None else "auto").lower()
+    if mode in ("off", "false", "0", "host"):
+        return False
+    if not trees or any(getattr(t, "is_linear", False) for t in trees):
+        return False
+    return True
+
+
 def loaded_pred_contrib(model, data: np.ndarray, start_iteration: int = 0,
-                        num_iteration: int = -1) -> np.ndarray:
+                        num_iteration: int = -1,
+                        predict_chunk=None) -> np.ndarray:
     """SHAP values for a model loaded from text (model_io.LoadedModel)."""
     data = np.asarray(data, np.float64)
     k = max(model.num_tree_per_iteration, 1)
+    n_iters = model.num_iterations
+    end = n_iters if num_iteration < 0 else min(
+        n_iters, start_iteration + num_iteration)
+    chunk = int(predict_chunk or model.predict_chunk or (1 << 20))
+    trees = model.trees[start_iteration * k:end * k]
+    if _use_device(model.params.get("tpu_shap", "auto"), trees):
+        from .ops.shap import shap_contrib_cached
+        # same cache key convention as LoadedModel.predict_raw, so the
+        # path pack rides the same owner packer as the traversal pack
+        return shap_contrib_cached(
+            model, trees, k, data, model.max_feature_idx + 1,
+            cache_key=(start_iteration, end, len(model.trees)),
+            chunk=chunk)
     return _contrib_over_trees(
-        lambda it, ki: model.trees[it * k + ki], model.num_iterations, k,
-        data, model.max_feature_idx + 1, start_iteration, num_iteration)
+        lambda it, ki: model.trees[it * k + ki], n_iters, k,
+        data, model.max_feature_idx + 1, start_iteration, num_iteration,
+        chunk=chunk)
 
 
 def predict_contrib(booster, data: np.ndarray, start_iteration: int = 0,
-                    num_iteration: int = -1) -> np.ndarray:
+                    num_iteration: int = -1,
+                    predict_chunk=None) -> np.ndarray:
     data = np.asarray(data, np.float64)
+    k = max(booster.num_tree_per_iteration, 1)
+    n_iters = len(booster.models)
+    end = n_iters if num_iteration < 0 else min(
+        n_iters, start_iteration + num_iteration)
+    cfg = getattr(booster, "config", None)
+    mode = getattr(cfg, "tpu_shap", "auto")
+    chunk = int(predict_chunk
+                or getattr(cfg, "tpu_predict_chunk", 0) or (1 << 20))
+    trees = [booster.models[it][ki]
+             for it in range(start_iteration, end) for ki in range(k)]
+    num_feat = booster.train_set.num_total_features
+    if _use_device(mode, trees):
+        from .ops.shap import shap_contrib_cached
+        # same cache key convention as GBDT.predict_raw
+        return shap_contrib_cached(
+            booster, trees, k, data, num_feat,
+            cache_key=(start_iteration, end, booster.current_iteration()),
+            chunk=chunk)
     return _contrib_over_trees(
-        lambda it, ki: booster.models[it][ki], len(booster.models),
-        booster.num_tree_per_iteration, data,
-        booster.train_set.num_total_features, start_iteration, num_iteration)
+        lambda it, ki: booster.models[it][ki], n_iters, k, data,
+        num_feat, start_iteration, num_iteration, chunk=chunk)

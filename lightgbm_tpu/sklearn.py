@@ -228,7 +228,8 @@ class LGBMModel:
                 pred_contrib: bool = False, **kwargs):
         self._check_fitted()
         # serving-engine kwargs (tpu_predict_chunk, ...) pass through to
-        # Booster.predict
+        # Booster.predict; pred_contrib=True rides the batched device
+        # TreeSHAP kernel (ops/shap.py) under the same chunk override
         return self._Booster.predict(
             X, raw_score=raw_score, start_iteration=start_iteration,
             num_iteration=num_iteration, pred_leaf=pred_leaf,

@@ -49,7 +49,7 @@ QUICK_FILES = {
     "test_bin_pack.py", "test_perf_gate.py", "test_memory_model.py",
     "test_obs_export.py", "test_health.py", "test_resilience.py",
     "test_stream.py", "test_coldstart.py", "test_profile.py",
-    "test_fleet.py", "test_watchdog.py",
+    "test_fleet.py", "test_watchdog.py", "test_shap.py",
 }
 
 

@@ -148,33 +148,44 @@ class TestTextfileFlusher:
 class TestXlaIntrospector:
     def test_enabled_routes_aot_and_records_cost(self):
         import jax
-        reg = XlaIntrospector()
-        reg.enable()
-        calls = []
+        # A persistent-cache-served compile is attributed to
+        # cache_load_s_total, NOT compile_s_total — so if a prior run
+        # already wrote this tiny program to the disk cache (conftest
+        # arms it), the compile_s_total assertions below would see 0.
+        # Pin the test to real compiles by detaching the disk cache.
+        prev_cache = jax.config.jax_compilation_cache_dir
+        jax.config.update("jax_compilation_cache_dir", None)
+        try:
+            reg = XlaIntrospector()
+            reg.enable()
+            calls = []
 
-        def f(x):
-            calls.append(1)
-            return (x * 2.0).sum()
+            def f(x):
+                calls.append(1)
+                return (x * 2.0).sum()
 
-        g = instrumented_jit("test/prog", f, phase="testing", registry=reg)
-        a = np.ones((64, 4), np.float32)
-        out1 = g(a)
-        out2 = g(a)  # same shape bucket: no second compile
-        assert float(out1) == float(out2) == 512.0
-        assert reg.n_programs == 1
-        recs = reg.records()
-        assert recs[0]["tag"] == "test/prog"
-        assert recs[0]["phase"] == "testing"
-        assert recs[0]["compile_s"] > 0
-        assert "64x4" in recs[0]["shapes"]
-        g(np.ones((128, 4), np.float32))  # new bucket: one more program
-        assert reg.n_programs == 2
-        s = reg.summary()
-        assert s["n_recompiles_by_phase"] == {"testing": 2}
-        assert s["compile_s_total"] > 0
-        assert s["by_tag"]["test/prog"]["programs"] == 2
-        # the AOT result equals the jit path bit-for-bit
-        assert float(g(a)) == float(jax.jit(f)(a))
+            g = instrumented_jit("test/prog", f, phase="testing",
+                                 registry=reg)
+            a = np.ones((64, 4), np.float32)
+            out1 = g(a)
+            out2 = g(a)  # same shape bucket: no second compile
+            assert float(out1) == float(out2) == 512.0
+            assert reg.n_programs == 1
+            recs = reg.records()
+            assert recs[0]["tag"] == "test/prog"
+            assert recs[0]["phase"] == "testing"
+            assert recs[0]["compile_s"] > 0
+            assert "64x4" in recs[0]["shapes"]
+            g(np.ones((128, 4), np.float32))  # new bucket: +1 program
+            assert reg.n_programs == 2
+            s = reg.summary()
+            assert s["n_recompiles_by_phase"] == {"testing": 2}
+            assert s["compile_s_total"] > 0
+            assert s["by_tag"]["test/prog"]["programs"] == 2
+            # the AOT result equals the jit path bit-for-bit
+            assert float(g(a)) == float(jax.jit(f)(a))
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev_cache)
 
     def test_cost_analysis_fields_when_backend_exposes_them(self):
         reg = XlaIntrospector()

@@ -1,0 +1,231 @@
+"""Device TreeSHAP (ops/shap.py + the shap.py dispatch): parity vs the
+host recursive oracle across the fixture matrix, the additivity
+invariant, prediction-window slicing, shape-stable recompile behavior,
+and the served ``explain`` route's bit-parity contract.
+
+Tolerances: the device kernel evaluates the permutation-weight
+recurrences in f32 (the f64 merged-path algorithm is exact to ~1e-13;
+the f32 noise floor is ~5e-4 relative), so parity against the f64 host
+recursion is asserted at 2e-3 relative — bit-parity is only claimed
+between the two DEVICE routes (direct predict_contrib vs served
+explain), which execute the identical compiled program.
+"""
+
+import asyncio
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import shap as shap_mod
+from lightgbm_tpu.obs.metrics import global_metrics
+from lightgbm_tpu.ops.shap import (MAX_CHUNK_ROWS, SHAP_TRACE_TAG,
+                                   shap_row_bucket)
+
+pytestmark = pytest.mark.quick
+
+TOL = 2e-3  # f32 recurrence vs the f64 recursive oracle
+
+
+def _train(x, y, extra=None, rounds=8, categorical=None):
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    params.update(extra or {})
+    ds = lgb.Dataset(x, label=y, params=params,
+                     categorical_feature=categorical or "auto")
+    return lgb.train(params, ds, num_boost_round=rounds)
+
+
+def _oracle(bst, data, start=0, num=-1):
+    g = bst._gbdt
+    k = max(getattr(g, "num_tree_per_iteration", 1), 1)
+    f = bst.num_feature()
+    return shap_mod._contrib_over_trees(
+        lambda it, ki: g.models[it][ki], g.current_iteration(), k,
+        np.asarray(data, np.float64), f, start, num)
+
+
+def _assert_close(dev, oracle):
+    scale = max(np.abs(oracle).max(), 1.0)
+    err = np.abs(np.asarray(dev) - oracle).max() / scale
+    assert err <= TOL, f"device vs oracle rel err {err:g}"
+
+
+def _nan_data(n=500, f=8, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, f)
+    x[::7, 2] = np.nan
+    y = ((np.nan_to_num(x[:, 2]) + x[:, 4]) > 0.5).astype(np.float64)
+    return x, y
+
+
+# ----------------------------------------------------------------------
+# parity matrix: device kernel vs the host recursion
+class TestOracleParity:
+    def test_binary_with_nans(self):
+        x, y = _nan_data()
+        bst = _train(x, y)
+        dev = bst.predict(x[:200], pred_contrib=True)
+        _assert_close(dev, _oracle(bst, x[:200]))
+
+    def test_multiclass_layout_and_parity(self):
+        from conftest import make_multiclass
+        x, y = make_multiclass(n=800, f=8, k=4)
+        params = {"objective": "multiclass", "num_class": 4,
+                  "num_leaves": 15, "min_data_in_leaf": 5,
+                  "verbosity": -1}
+        bst = lgb.train(params, lgb.Dataset(x, label=y, params=params),
+                        num_boost_round=5)
+        dev = bst.predict(x[:150], pred_contrib=True)
+        # reference layout: K blocks of (F + 1) columns per row
+        assert dev.shape == (150, 4 * (8 + 1))
+        _assert_close(dev, _oracle(bst, x[:150]))
+
+    def test_categorical_bitset(self):
+        rng = np.random.RandomState(1)
+        n, f = 600, 6
+        x = rng.randn(n, f)
+        x[:, 0] = rng.randint(0, 12, n)  # categorical columns
+        x[:, 1] = rng.randint(0, 40, n)  # spills past one bitset word
+        y = ((x[:, 0] % 3 == 1) * 2.0 + (x[:, 1] > 20) * 1.5
+             + x[:, 3] > 1.0).astype(np.float64)
+        bst = _train(x, y, categorical=[0, 1])
+        probe = x[:150].copy()
+        probe[5, 0] = 99.0   # out-of-range category
+        probe[6, 1] = -3.0   # negative -> out of range
+        dev = bst.predict(probe, pred_contrib=True)
+        _assert_close(dev, _oracle(bst, probe))
+
+    def test_dart_shrinkage_invalidates_pack(self):
+        # DART renormalizes leaf values BETWEEN iterations — the pack's
+        # identity tokens must catch the in-place mutation, or contribs
+        # would come from stale path tables
+        x, y = _nan_data(seed=3)
+        bst = _train(x, y, extra={"boosting": "dart", "drop_rate": 0.3,
+                                  "drop_seed": 7}, rounds=10)
+        dev = bst.predict(x[:120], pred_contrib=True)
+        _assert_close(dev, _oracle(bst, x[:120]))
+
+
+class TestMissingTypeMatrix:
+    """All three reference missing routings: None, Zero, NaN."""
+
+    def test_missing_none(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(500, 6)
+        y = (x[:, 0] + x[:, 1] > 0.3).astype(np.float64)
+        bst = _train(x, y, extra={"use_missing": False})
+        dev = bst.predict(x[:150], pred_contrib=True)
+        _assert_close(dev, _oracle(bst, x[:150]))
+
+    def test_missing_zero(self):
+        rng = np.random.RandomState(4)
+        x = rng.randn(500, 6)
+        x[::5, 1] = 0.0
+        y = ((x[:, 0] > 0) & (x[:, 1] != 0)).astype(np.float64)
+        bst = _train(x, y, extra={"zero_as_missing": True})
+        probe = x[:150].copy()
+        probe[3, 0] = np.nan  # NaN routes like zero under MissingType.Zero
+        dev = bst.predict(probe, pred_contrib=True)
+        _assert_close(dev, _oracle(bst, probe))
+
+    def test_missing_nan(self):
+        x, y = _nan_data(seed=5)
+        bst = _train(x, y)
+        probe = x[:150].copy()
+        probe[::3, 4] = np.nan  # NaNs on a feature with no train NaNs
+        dev = bst.predict(probe, pred_contrib=True)
+        _assert_close(dev, _oracle(bst, probe))
+
+
+# ----------------------------------------------------------------------
+# invariants
+class TestInvariants:
+    def test_additivity(self):
+        x, y = _nan_data(seed=6)
+        bst = _train(x, y)
+        dev = bst.predict(x[:200], pred_contrib=True)
+        raw = bst.predict(x[:200], raw_score=True)
+        err = np.abs(dev.sum(axis=1) - raw).max() / max(
+            np.abs(raw).max(), 1.0)
+        assert err <= TOL, f"additivity rel err {err:g}"
+
+    def test_iteration_slicing_parity(self):
+        x, y = _nan_data(seed=7)
+        bst = _train(x, y, rounds=10)
+        for start, num in ((0, 4), (3, 5), (2, -1)):
+            dev = bst.predict(x[:100], pred_contrib=True,
+                              start_iteration=start, num_iteration=num)
+            _assert_close(dev, _oracle(bst, x[:100], start, num))
+
+    def test_linear_trees_rejected(self):
+        x, y = _nan_data(seed=8)
+        x2 = np.nan_to_num(x)
+        bst = _train(x2, y, extra={"linear_tree": True})
+        with pytest.raises(ValueError, match="linear"):
+            bst.predict(x2[:10], pred_contrib=True)
+
+    def test_row_bucket_is_pow2_and_capped(self):
+        assert shap_row_bucket(1, 4096) == 16      # lowlat floor
+        assert shap_row_bucket(17, 4096) == 32
+        assert shap_row_bucket(700, 4096) == 1024  # pow2, NOT grain 768
+        assert shap_row_bucket(5000, 4096) == 4096  # chunk cap
+        assert shap_row_bucket(100, 64) == 64
+        assert MAX_CHUNK_ROWS == 4096
+
+
+# ----------------------------------------------------------------------
+# shape stability: uneven row counts must reuse the warm bucket set
+class TestRecompileStability:
+    def test_zero_steady_state_recompiles(self):
+        x, y = _nan_data(seed=9)
+        bst = _train(x, y)
+        rng = np.random.RandomState(0)
+        big = rng.randn(512, x.shape[1])
+        for b in (16, 32, 64, 128, 256, 512):  # warm the pow2 ladder
+            bst.predict(big[:b], pred_contrib=True)
+        base = global_metrics.recompiles(SHAP_TRACE_TAG)
+        for n in (1, 3, 16, 17, 129, 255, 256, 300, 511, 512, 7):
+            bst.predict(big[:n], pred_contrib=True)
+        assert global_metrics.recompiles(SHAP_TRACE_TAG) == base
+
+
+# ----------------------------------------------------------------------
+# served explain route
+class TestServedExplain:
+    def test_explain_bit_identical_to_direct(self):
+        from lightgbm_tpu.serve import ModelRegistry, ModelServer
+
+        x, y = _nan_data(seed=10)
+        bst = _train(x, y)
+        registry = ModelRegistry()
+        registry.load("m", booster=bst)
+        direct = registry.get("m").model
+        server = ModelServer(registry, max_batch_rows=512,
+                             max_wait_ms=1.0)
+        rng = np.random.RandomState(1)
+        xt = rng.randn(600, x.shape[1])
+        xt[::9, 2] = np.nan
+        sizes = (1, 40, 130, 3, 64, 200, 17)
+        bounds = np.concatenate([[0], np.cumsum(sizes)])
+
+        async def run():
+            try:
+                return await asyncio.gather(*[
+                    server.explain("m", xt[bounds[i]:bounds[i + 1]])
+                    for i in range(len(sizes))])
+            finally:
+                await server.close()
+
+        outs = asyncio.run(run())
+        for i, out in enumerate(outs):
+            want = direct.predict_contrib(xt[bounds[i]:bounds[i + 1]])
+            assert np.array_equal(out, want), f"request {i} diverged"
+
+    def test_check_shap_tool(self, capsys):
+        sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools"))
+        import check_shap
+        assert check_shap.main() == 0

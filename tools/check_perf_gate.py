@@ -2,7 +2,7 @@
 """Perf-regression gate (ROADMAP item 4: convert "should be fast" into
 driver-visible proof).
 
-Twelve checks, all against the recorded floor in tools/perf_floor.json:
+Thirteen checks, all against the recorded floor in tools/perf_floor.json:
 
 1. **Histogram traffic model** — recomputes the static per-iteration
    HBM byte model (learner.hist_traffic_model) for the recorded
@@ -98,6 +98,15 @@ Twelve checks, all against the recorded floor in tools/perf_floor.json:
     killed replica must land in quarantine, and the served answers
     must stay bit-identical to a direct predict (check_fleet_
     availability). Graceful skip when no fleet bench ran.
+
+13. **SHAP contributions** — over the latest bench record carrying a
+    ``shap`` summary (bench.py --shap: the batched device TreeSHAP
+    kernel vs the same-run host recursive oracle): the device speedup
+    must clear the per-platform ``min_speedup_vs_host`` floor, the
+    kernel must have matched the oracle on the parity subset, and the
+    measured path-table pack bytes must land inside the configured
+    band of the analytic memory model's ``shap_pack`` component
+    (check_shap). Graceful skip when no shap bench ran.
 
 Exit 0 = gate passed; exit 1 = regression, with one line per failure.
 Wired into the quick verification tier via tests/test_perf_gate.py.
@@ -811,6 +820,67 @@ def check_fleet_availability(floor, failures, candidate_path=None):
               f"{min_avail:.1%})")
 
 
+def check_shap(floor, failures, candidate_path=None):
+    """SHAP-contribution floors (check 13): over the latest bench
+    record carrying a ``shap`` summary (bench.py --shap), the batched
+    device kernel must be at least ``min_speedup_vs_host_<platform>`` x
+    faster than the same-run host recursive oracle (the whole point of
+    the path-decomposed reformulation), the parity subset must have
+    matched (no PARITY-MISMATCH marker), and the measured path-table
+    pack bytes must sit within ``pack_vs_model_band`` of the analytic
+    memory model's shap_pack component — the band that keeps
+    preflight's fit/doesn't-fit verdicts honest for explain traffic.
+    No shap bench recorded => the check reports itself skipped."""
+    cfg = floor.get("shap")
+    if not cfg:
+        print("# no shap floor recorded; shap check skipped")
+        return
+    recs = _load_keyed_records("shap", candidate_path)
+    if not recs:
+        print("# no shap bench recorded; shap check skipped")
+        return
+    tag, rec = recs[-1]
+    sh = rec["shap"]
+    speedup = float(rec.get("vs_baseline", 0.0) or 0.0)
+    if speedup <= 0.0:
+        print(f"# shap[{tag}]: no oracle anchor recorded; shap check "
+              "skipped")
+        return
+    n_fail0 = len(failures)
+    platform = _platform_of(rec.get("unit", ""))
+    min_speedup = float(cfg.get(
+        f"min_speedup_vs_host_{platform}",
+        cfg.get("min_speedup_vs_host_cpu", 5.0)))
+    if speedup < min_speedup:
+        failures.append(
+            f"{tag}: device TreeSHAP is only {speedup:.2f}x the host "
+            f"recursive oracle (platform={platform}, floor "
+            f"{min_speedup:.1f}x) — the batched kernel lost its edge")
+    if "PARITY-MISMATCH" in str(rec.get("unit", "")):
+        failures.append(
+            f"{tag}: shap bench flagged PARITY-MISMATCH — device "
+            "contributions diverged from the host oracle beyond f32 "
+            "recurrence tolerance")
+    pack = float(sh.get("pack_bytes", 0.0) or 0.0)
+    model = float(sh.get("model_pack_bytes", 0.0) or 0.0)
+    band = float(cfg.get("pack_vs_model_band", 2.0))
+    if pack > 0.0 and model > 0.0:
+        ratio = pack / model
+        if ratio > band or ratio < 1.0 / band:
+            failures.append(
+                f"{tag}: measured path-table pack {pack / 1e6:.2f} MB is "
+                f"outside the {band}x band of the analytic model's "
+                f"{model / 1e6:.2f} MB (ratio {ratio:.2f}) — "
+                "predict_memory_model(contrib=True) no longer tracks "
+                "the packer")
+    if len(failures) == n_fail0:
+        print(f"# shap[{tag}]: {speedup:.1f}x vs host oracle "
+              f"(platform={platform}, floor {min_speedup:.1f}x), "
+              f"pack {pack / 1e6:.2f} MB vs model {model / 1e6:.2f} MB, "
+              f"paths={int(sh.get('paths', 0))} "
+              f"depth={int(sh.get('depth', 0))}")
+
+
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
@@ -871,6 +941,7 @@ def main(argv=None) -> int:
     check_coldstart(floor, failures, candidate)
     check_profile_roofline(floor, failures, candidate)
     check_fleet_availability(floor, failures, candidate)
+    check_shap(floor, failures, candidate)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")

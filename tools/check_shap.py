@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""CI smoke for the TreeSHAP explanation path (ops/shap.py + the
+served ``explain`` route).
+
+Three assertions, mirroring tools/check_serve.py for the explain
+subsystem:
+
+1. **Oracle parity**: the batched device kernel's contributions match
+   the reference-recursion host oracle (shap._tree_shap) on a mixed
+   fixture — binary model trained on data with NaNs — within f32
+   recurrence tolerance, and additivity holds (contributions sum to
+   the raw prediction per row).
+2. **Served bit-parity**: every ``ModelServer.explain`` response —
+   low-latency AOT ladder and coalesced micro-batches alike — is
+   BIT-identical to calling ``predict_contrib`` directly on that
+   request's rows, with ZERO steady-state recompiles after warmup on
+   both the streaming kernel tag and the AOT explain tag.
+3. **Metrics lint**: the rendered OpenMetrics document carries the
+   ``lgbmtpu_explain_*`` families (request/row counters + the
+   dedicated latency summary).
+
+Exit 0 = pass. Usage: python tools/check_shap.py
+"""
+
+import asyncio
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs.export import render_openmetrics
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.ops.shap import SHAP_TRACE_TAG
+    from lightgbm_tpu.serve import (ModelRegistry, ModelServer,
+                                    SERVE_EXPLAIN_TAG)
+    from lightgbm_tpu import shap as shap_mod
+
+    failures = 0
+    rng = np.random.RandomState(0)
+    n, f = 1200, 10
+    x = rng.randn(n, f)
+    x[::7, 2] = np.nan
+    y = ((np.nan_to_num(x[:, 2]) + x[:, 4]) > 0.5).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1}
+    bst = lgb.train(params, lgb.Dataset(x, label=y, params=params),
+                    num_boost_round=10)
+
+    # 1. device kernel vs host recursive oracle + additivity
+    probe = x[:256]
+    dev = bst.predict(probe, pred_contrib=True)
+    gbdt = bst._gbdt
+    oracle = shap_mod._contrib_over_trees(
+        lambda it, ki: gbdt.models[it][ki], gbdt.current_iteration(), 1,
+        probe, f, 0, -1)
+    scale = max(np.abs(oracle).max(), 1.0)
+    err = np.abs(dev - oracle).max() / scale
+    if err > 2e-3:
+        print(f"FAIL: device contribs vs host oracle rel err {err:g}")
+        failures += 1
+    raw = bst.predict(probe, raw_score=True)
+    add_err = np.abs(dev.sum(axis=1) - raw).max() / max(
+        np.abs(raw).max(), 1.0)
+    if add_err > 2e-3:
+        print(f"FAIL: additivity rel err {add_err:g}")
+        failures += 1
+
+    # 2. served explain route: bit-parity + zero steady-state recompiles
+    registry = ModelRegistry()
+    registry.load("smoke", booster=bst)
+    direct = registry.get("smoke").model
+    server = ModelServer(registry, max_batch_rows=1024, max_wait_ms=1.0)
+    server.warm("smoke", f, explain=True)
+
+    warm_explain = global_metrics.recompiles(SERVE_EXPLAIN_TAG)
+    warm_kernel = global_metrics.recompiles(SHAP_TRACE_TAG)
+
+    # mixed sizes: lowlat ladder (<=64), coalescable mediums, and one
+    # oversized request per cycle; uneven counts exercise the buckets
+    cycle = (1, 3, 8, 17, 64, 2, 130, 31, 257, 5, 700, 16, 64, 1, 23)
+    sizes = [cycle[i % len(cycle)] for i in range(60)]
+    xt = rng.randn(sum(sizes), f)
+    xt[::9, 2] = np.nan
+
+    async def run():
+        async def one(lo, hi):
+            return await server.explain("smoke", xt[lo:hi])
+
+        tasks = []
+        lo = 0
+        for s in sizes:
+            tasks.append(asyncio.ensure_future(one(lo, lo + s)))
+            lo += s
+        try:
+            return await asyncio.gather(*tasks)
+        finally:
+            await server.close()
+
+    t0 = time.perf_counter()
+    outs = asyncio.run(run())
+    elapsed = time.perf_counter() - t0
+
+    lo = 0
+    for i, (s, out) in enumerate(zip(sizes, outs)):
+        hi = lo + s
+        want = direct.predict_contrib(xt[lo:hi])
+        if not np.array_equal(out, want):
+            print(f"FAIL: explain request {i} ({s} rows) != direct "
+                  f"predict_contrib (max abs diff "
+                  f"{np.abs(out - want).max():g})")
+            failures += 1
+        lo = hi
+
+    d_explain = global_metrics.recompiles(SERVE_EXPLAIN_TAG) - warm_explain
+    d_kernel = global_metrics.recompiles(SHAP_TRACE_TAG) - warm_kernel
+    if d_explain or d_kernel:
+        print(f"FAIL: steady-state recompiles (explain_lowlat="
+              f"{d_explain}, shap_kernel={d_kernel}) — the warm "
+              "bucket set leaked")
+        failures += 1
+    coalesced = global_metrics.counters.get("explain/coalesced_requests", 0)
+    if not coalesced:
+        print("FAIL: no explain requests coalesced — the mixed replay "
+              "must exercise the explain micro-batcher")
+        failures += 1
+
+    # 3. OpenMetrics lint: the explain families must render
+    doc = render_openmetrics()
+    for family in ("lgbmtpu_explain_requests_total",
+                   "lgbmtpu_explain_rows_total",
+                   "lgbmtpu_explain_lowlat_requests_total",
+                   "lgbmtpu_explain_batched_requests_total",
+                   "lgbmtpu_explain_latency_seconds"):
+        if family not in doc:
+            print(f"FAIL: family {family} missing from the rendered "
+                  "OpenMetrics document")
+            failures += 1
+
+    lat = global_metrics.latency_summary("explain/request")
+    counters = {k: v for k, v in sorted(global_metrics.counters.items())
+                if k.startswith("explain/")}
+    print(f"explained {len(outs)} requests ({lo} rows) in {elapsed:.2f}s "
+          f"({lo / elapsed:.0f} rows/s); p50={lat['p50_ms']:.2f}ms "
+          f"p99={lat['p99_ms']:.2f}ms; counters={counters}")
+    if failures:
+        print(f"check_shap: {failures} failure(s)")
+        return 1
+    print("check_shap: OK (oracle parity, served bit-parity incl. "
+          "coalesced batches, zero steady-state recompiles, "
+          "lgbmtpu_explain_* families present)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
