@@ -428,6 +428,16 @@ def _measure():
             "hist_traffic_oracle"]["hist_bytes_per_iter"]
         result["hist_bytes_reduction"] = global_metrics.meta[
             "hist_bytes_reduction"]
+    # cross-device collective traffic model (set when a mesh is active):
+    # bytes/iter the active tpu_hist_reduce mode puts on ICI/DCN vs the
+    # full-histogram psum oracle. Checked by check_perf_gate.py check 14.
+    ct = global_metrics.meta.get("collective_traffic")
+    if ct:
+        result["collective_bytes_per_iter"] = ct[
+            "collective_bytes_per_iter"]
+        result["collective_reduction_mode"] = ct["reduction"]
+        result["collective_reduction"] = global_metrics.meta[
+            "collective_reduction"]
     # peak-HBM accounting (obs/memory.py): the analytic model is
     # always-on meta; the measured peak exists only on accelerator
     # backends (memory_stats() is None on CPU). check_perf_gate.py

@@ -489,7 +489,8 @@ class Booster:
         return self._gbdt.train_one_iter()
 
     def _raw_train_scores(self) -> np.ndarray:
-        s = np.asarray(self._gbdt.scores)
+        # score storage may carry padded tail rows when sharded
+        s = np.asarray(self._gbdt.scores)[:, :self._gbdt.num_data]
         return s[0] if s.shape[0] == 1 else s.T.reshape(-1)
 
     def rollback_one_iter(self) -> "Booster":
@@ -539,7 +540,8 @@ class Booster:
         return out
 
     def eval_train(self, feval=None):
-        raw = np.asarray(self._gbdt.scores).T  # [N, K]
+        raw = np.asarray(self._gbdt.scores)[:, :self._gbdt.num_data].T
+        # [N, K]; padded tail rows (sharded storage) dropped above
         res = self._eval_scores(raw, self.train_set._binned, "training")
         if feval is not None:
             res += _call_feval(feval, raw, self.train_set, "training")

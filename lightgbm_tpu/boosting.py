@@ -109,6 +109,10 @@ class GBDT:
             else self.num_class)
         self.shrinkage_rate = config.learning_rate
         self.iter = 0
+        # masked pad rows appended to the row tensors so they divide a
+        # device mesh (parallel/data_parallel._pad_and_shard_rows);
+        # num_data stays the REAL row count throughout
+        self._row_pad = 0
         # host trees (materialized lazily from device records on the fast
         # path; populated directly on the slow path)
         self._host_models: List[List[Tree]] = []
@@ -467,13 +471,15 @@ class GBDT:
                     out[gi, used_map[raw_f]] = True
         return out
 
-    def _build_grow(self, hist_impl: str, shard_mesh=None) -> None:
+    def _build_grow(self, hist_impl: str, shard_mesh=None,
+                    hist_reduce: str = "psum") -> None:
         if self.config.deterministic_hist:
             # Kahan-compensated accumulation lives on the XLA path; the
             # pallas kernels keep their own (non-compensated) order
             hist_impl = "xla"
         self._hist_impl = hist_impl
         self._shard_mesh = shard_mesh
+        self._hist_reduce = hist_reduce if shard_mesh is not None else "psum"
         self._has_categorical = any(
             m.is_categorical for m in self.train_set.mappers)
         # per-node randomness (extra-trees thresholds, by-node feature
@@ -499,6 +505,7 @@ class GBDT:
         self._record_lrs: List[float] = []
         self._valid_bins: List = []  # device bins per valid set (fast path)
         self._note_hist_traffic()
+        self._note_collective_traffic()
         self._note_memory_model()
         self._note_bin_occupancy()
 
@@ -568,6 +575,41 @@ class GBDT:
             "hist_bytes_reduction",
             round(oracle["hist_bytes_per_iter"]
                   / max(actual["hist_bytes_per_iter"], 1), 4))
+
+    def _note_collective_traffic(self) -> None:
+        """Publish the static per-iteration COLLECTIVE traffic model —
+        the interconnect counterpart of ``_note_hist_traffic`` for mesh
+        training (ROADMAP item 3's driver-visible counter for the
+        reduce-scatter learner). Always computes the psum oracle next
+        to the resolved mode so ``collective_reduction`` prices what
+        ``tpu_hist_reduce=scatter`` saves: ~W-fold fewer bytes on the
+        wire per iteration at equal models."""
+        mesh = getattr(self, "_shard_mesh", None)
+        if mesh is None or self._sparse_shape is not None:
+            return
+        from .learner import collective_traffic_model
+        shape = self._resolved_hist_shape()
+        axes = tuple(mesh.axis_names)
+        width = int(mesh.shape[axes[-1]])
+        dcn = int(mesh.size) // max(width, 1)
+        reduction = getattr(self, "_hist_reduce", "psum")
+        if self._bundle is not None:
+            reduction = "psum"  # the learner demotes bundled storage
+        kw = dict(num_features=int(self.train_set.num_features),
+                  max_bins=int(self._static["max_bins"]),
+                  num_leaves=shape["num_leaves"],
+                  wave_max=shape["wave_max"], width=width, dcn=dcn,
+                  subtract=bool(self.config.tpu_wave_subtract),
+                  waved=shape["waved"])
+        actual = collective_traffic_model(reduction=reduction, **kw)
+        oracle = collective_traffic_model(reduction="psum", **kw)
+        global_metrics.set_meta("collective_traffic", actual)
+        global_metrics.set_meta("collective_traffic_psum", oracle)
+        global_metrics.set_meta("collective_bytes_per_iter",
+                                actual["collective_bytes_per_iter"])
+        global_metrics.set_meta("collective_reduction", round(
+            oracle["collective_bytes_per_iter"]
+            / max(actual["collective_bytes_per_iter"], 1), 4))
 
     def _memory_model_kwargs(self) -> Dict:
         """The analytic peak-HBM model's kwargs with every knob RESOLVED
@@ -792,6 +834,22 @@ class GBDT:
         g, h = obj.get_gradients(scores[0])
         return g[None, :], h[None, :]
 
+    def _pad_tail(self, x, value):
+        """Pad a per-row vector back to the padded storage length.
+
+        Sharded row storage may carry ``_row_pad`` masked tail rows (see
+        DataParallelGBDT._pad_and_shard_rows). Per-row quantities drawn at
+        the real length keep their bits (same key, same shape) and the
+        tail gets a neutral ``value`` so the padded rows stay inert.
+        """
+        if self._row_pad == 0:
+            return x
+        return jnp.pad(x, (0, self._row_pad), constant_values=value)
+
+    def _valid_rows(self, n):
+        """Bool [n] marking real rows (False on the padded tail)."""
+        return jnp.arange(n) < self.num_data
+
     def _sampling_in_jit(self, key, it, prev_mask):
         """Bagging mask (traced; ref: bagging.hpp Bagging)."""
         cfg = self.config
@@ -800,7 +858,7 @@ class GBDT:
             or cfg.neg_bagging_fraction < 1.0)
         if not use_bagging:
             return prev_mask
-        u = jax.random.uniform(key, (self.num_data,))
+        u = self._pad_tail(jax.random.uniform(key, (self.num_data,)), 2.0)
         pos_neg = (cfg.pos_bagging_fraction < 1.0 or
                    cfg.neg_bagging_fraction < 1.0) and \
             self.objective is not None and self.objective.name == "binary"
@@ -821,9 +879,12 @@ class GBDT:
         top_k = max(1, int(n * cfg.top_rate))
         other_k = max(1, int(n * cfg.other_rate))
         score = jnp.abs(grad) * jnp.abs(hess)
+        if self._row_pad:
+            # padded tail must not claim top-k slots or survive sampling
+            score = jnp.where(self._valid_rows(score.shape[0]), score, -1.0)
         thr = -jnp.sort(-score)[top_k - 1]
         is_top = score >= thr
-        u = jax.random.uniform(key, (n,))
+        u = self._pad_tail(jax.random.uniform(key, (n,)), 2.0)
         keep_rest_p = other_k / max(n - top_k, 1)
         is_other = (~is_top) & (u < keep_rest_p)
         amplify = (1.0 - cfg.top_rate) / cfg.other_rate
@@ -843,14 +904,28 @@ class GBDT:
         bins = max(int(cfg.num_grad_quant_bins), 2)
         const_h = (self.objective is not None and
                    self.objective.is_constant_hessian)
-        max_g = jnp.maximum(jnp.max(jnp.abs(grad)), K_EPSILON)
-        max_h = jnp.maximum(jnp.max(jnp.abs(hess)), K_EPSILON)
+        abs_g, abs_h = jnp.abs(grad), jnp.abs(hess)
+        if self._row_pad:
+            valid = self._valid_rows(abs_g.shape[0])
+            abs_g = jnp.where(valid, abs_g, 0.0)
+            abs_h = jnp.where(valid, abs_h, 0.0)
+        max_g = jnp.maximum(jnp.max(abs_g), K_EPSILON)
+        max_h = jnp.maximum(jnp.max(abs_h), K_EPSILON)
         g_scale = max_g / (bins // 2)
         h_scale = max_h if const_h else max_h / bins
         if cfg.stochastic_rounding:
             kg, kh = jax.random.split(key)
-            u_g = jax.random.uniform(kg, grad.shape)
-            u_h = jax.random.uniform(kh, hess.shape)
+            if self._row_pad:
+                # draw at the REAL length, then pad: threefry draws are
+                # shape-dependent, so drawing at the padded length would
+                # move every real row's rounding off the serial stream
+                u_g = self._pad_tail(
+                    jax.random.uniform(kg, (self.num_data,)), 0.5)
+                u_h = self._pad_tail(
+                    jax.random.uniform(kh, (self.num_data,)), 0.5)
+            else:
+                u_g = jax.random.uniform(kg, grad.shape)
+                u_h = jax.random.uniform(kh, hess.shape)
         else:
             u_g = u_h = 0.5
         g_int = jnp.trunc(grad / g_scale + jnp.sign(grad) * u_g)
@@ -898,7 +973,9 @@ class GBDT:
                                  extra_trees=bool(self.config.extra_trees),
                                  ff_bynode=float(
                                      self.config.feature_fraction_bynode),
-                                 shard_mesh=self._shard_mesh)
+                                 shard_mesh=self._shard_mesh,
+                                 hist_reduce=getattr(
+                                     self, "_hist_reduce", "psum"))
 
     def _grow_class_traced(self, grow, bins_fm, k, key, grad, hess,
                            sample_mask, scores_k, it):
@@ -1413,9 +1490,11 @@ class GBDT:
             is_pos = jnp.asarray(self.objective.label_np > 0)
             frac = jnp.where(is_pos, cfg.pos_bagging_fraction,
                              cfg.neg_bagging_fraction)
-            self._sample_mask = (u < frac).astype(jnp.float32)
+            self._sample_mask = self._pad_tail(
+                (u < frac).astype(jnp.float32), 0.0)
         else:
-            self._sample_mask = (u < cfg.bagging_fraction).astype(jnp.float32)
+            self._sample_mask = self._pad_tail(
+                (u < cfg.bagging_fraction).astype(jnp.float32), 0.0)
 
     def _goss_mask(self, grad, hess):
         """GOSS: keep top_rate by |g*h|, sample other_rate of the rest and
@@ -1426,10 +1505,12 @@ class GBDT:
         top_k = max(1, int(n * top_rate))
         other_k = max(1, int(n * other_rate))
         score = jnp.abs(grad) * jnp.abs(hess)
+        if self._row_pad:
+            score = jnp.where(self._valid_rows(score.shape[0]), score, -1.0)
         thr = -jnp.sort(-score)[top_k - 1]
         is_top = score >= thr
         key = jax.random.fold_in(self._bagging_key, self.iter + (1 << 20))
-        u = jax.random.uniform(key, (n,))
+        u = self._pad_tail(jax.random.uniform(key, (n,)), 2.0)
         keep_rest_p = other_k / max(n - top_k, 1)
         is_other = (~is_top) & (u < keep_rest_p)
         amplify = (1.0 - top_rate) / other_rate
@@ -1484,6 +1565,9 @@ class GBDT:
                 self.num_tree_per_iteration, self.num_data))
             h = jnp.asarray(np.asarray(custom_hess, np.float32).reshape(
                 self.num_tree_per_iteration, self.num_data))
+            if self._row_pad:
+                pad = ((0, 0), (0, self._row_pad))
+                g, h = jnp.pad(g, pad), jnp.pad(h, pad)
             return g, h
         obj = self.objective
         if hasattr(obj, "get_gradients_multi"):
@@ -1718,9 +1802,12 @@ class GBDT:
                 should_continue = True
                 # RenewTreeOutput for L1-family (ref: gbdt.cpp:420)
                 if self.objective is not None:
+                    # host renewal pairs these with real-length label
+                    # arrays — drop the padded tail rows
+                    nd = self.num_data
                     renewed = self.objective.renew_tree_output(
-                        tree, np.asarray(self.scores[k]),
-                        np.asarray(row_leaf), np.asarray(mask))
+                        tree, np.asarray(self.scores[k])[:nd],
+                        np.asarray(row_leaf)[:nd], np.asarray(mask)[:nd])
                     if renewed is not None:
                         tree = renewed
                 if self.config.linear_tree:
@@ -1918,9 +2005,11 @@ class GBDT:
             return off
 
         raw = self.predict_raw(self.train_set.raw_data)  # [N, K]
-        self.scores = jnp.asarray(
-            raw.T.astype(np.float32) + _dataset_init_offset(
-                self.train_set.metadata.init_score, self.num_data))
+        scores = raw.T.astype(np.float32) + _dataset_init_offset(
+            self.train_set.metadata.init_score, self.num_data)
+        if self._row_pad:
+            scores = np.pad(scores, ((0, 0), (0, self._row_pad)))
+        self.scores = jnp.asarray(scores)
         for i, (vs, raw_v) in enumerate(self._valid_sets):
             vraw = self.predict_raw(raw_v)  # handles sparse + dense
             self._valid_scores[i] = jnp.asarray(

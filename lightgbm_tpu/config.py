@@ -173,6 +173,7 @@ _ALIASES: Dict[str, List[str]] = {
     "tpu_wave_max": [],
     "tpu_hist_precision": [],
     "tpu_hist_impl": [],
+    "tpu_hist_reduce": ["hist_reduce"],
     "tpu_sparse_hist": [],
     "tpu_bin_pack": ["bin_pack"],
     "tpu_stream": ["stream", "out_of_core"],
@@ -516,6 +517,18 @@ class Config:
     # (pallas on CPU runs in interpret mode — tests use this to exercise
     # the kernel + its shard_map mesh wrapper without a chip)
     tpu_hist_impl: str = "auto"
+    # data-parallel histogram reduction (parallel/scatter.py): "psum"
+    # all-reduces full [F, B, 3] histograms every pass (the A/B
+    # oracle); "scatter" reduce-scatters them over a static feature
+    # partition — each shard aggregates + split-searches only its 1/W
+    # feature slice and per-shard winners sync as ONE SplitInfo record
+    # each (ref: data_parallel_tree_learner.cpp:287-297), cutting
+    # collective bytes/iter ~W-fold with bit-identical models. "auto"
+    # picks scatter on multi-device meshes when the feature count
+    # partitions evenly (voting learner: always — it pads internally),
+    # psum otherwise. EFB-bundled / COO-sparse / streamed storage and
+    # single-device runs always use psum.
+    tpu_hist_reduce: str = "auto"
     # sparse row-wise COO histograms for ultra-sparse non-bundleable
     # input (ref: multi_val_sparse_bin.hpp:21): "auto" picks COO when
     # the estimated O(nnz) segment-sum work beats the dense/EFB layout,

@@ -185,26 +185,59 @@ def _pad_rows(arrays, axes, n: int, mult: int, pad_values):
 
 
 def _sharded_pallas_build(shard_mesh, *, max_bins: int, dtype,
-                          row_chunk: int, precision: str):
+                          row_chunk: int, precision: str,
+                          impl: str = "pallas",
+                          hist_reduce: str = "psum",
+                          deterministic: bool = False):
     """Single-leaf histogram build distributed over the mesh row axis:
-    each shard runs the pallas kernel on its rows, results psum-reduce —
+    each shard runs the histogram kernel on its rows, results reduce —
     the shard_map analog of HistogramSumReducer + Allreduce
-    (ref: data_parallel_tree_learner.cpp:287-297)."""
+    (ref: data_parallel_tree_learner.cpp:287-297).
+
+    hist_reduce="scatter" replaces the full-histogram psum with a
+    ``psum_scatter`` over the (zero-padded) feature axis: each shard
+    receives only its owned 1/W feature slice — the reference's
+    ReduceScatter — and the result stays feature-sharded for the
+    scatter split stage (parallel/scatter.py). Bitwise: psum_scatter
+    slices equal the matching psum rows, so models are unchanged.
+
+    On a hierarchical ("dcn", "ici") mesh, rows shard over BOTH axes,
+    the scatter runs over the fast in-process ICI axis and the owned
+    slice then psums over the slow DCN link — only 1/W_ici of the
+    histogram ever crosses DCN (int32 on the quantized path, so the
+    compressed partial sums stay exact)."""
     from jax.sharding import PartitionSpec as P
-    axis = shard_mesh.axis_names[0]
+    axes = tuple(shard_mesh.axis_names)
+    row_axes = axes if len(axes) > 1 else axes[0]
+    scat_axis = axes[-1]
+    width = int(shard_mesh.shape[scat_axis])
+    scatter = hist_reduce == "scatter"
 
     def local(b_l, g_l, h_l, m_l):
         hl = hist_ops.build_histogram(
             b_l, g_l, h_l, m_l, max_bins=max_bins, dtype=dtype,
-            row_chunk=row_chunk, impl="pallas", precision=precision)
+            row_chunk=row_chunk, impl=impl, precision=precision,
+            deterministic=deterministic)
         # tagged health wrapper: trace-time counters + runtime per-call
         # attribution through the enclosing program's manifest
-        return obs_health.psum(hl, axis, tag="hist/psum")
+        if not scatter:
+            return obs_health.psum(hl, row_axes, tag="hist/psum")
+        fpad = (-hl.shape[0]) % width
+        if fpad:
+            hl = jnp.pad(hl, ((0, fpad), (0, 0), (0, 0)))
+        hl = obs_health.psum_scatter(hl, scat_axis,
+                                     tag="hist/psum_scatter",
+                                     scatter_dimension=0)
+        if len(axes) > 1:
+            hl = obs_health.psum(hl, axes[:-1], tag="hist/psum_dcn")
+        return hl
 
     from .parallel.mesh import shard_map as _shard_map
     fn = _shard_map(local, mesh=shard_mesh,
-                    in_specs=(P(None, axis), P(axis), P(axis), P(axis)),
-                    out_specs=P())
+                    in_specs=(P(None, row_axes), P(row_axes), P(row_axes),
+                              P(row_axes)),
+                    out_specs=(P(scat_axis, None, None) if scatter
+                               else P()))
 
     def build(bins, g, h, m):
         # padded rows carry mask 0 -> no histogram contribution
@@ -216,36 +249,79 @@ def _sharded_pallas_build(shard_mesh, *, max_bins: int, dtype,
 
 
 def _sharded_pallas_multi(shard_mesh, *, max_bins: int,
-                          precision: str, int8: bool):
+                          precision: str, int8: bool,
+                          impl: str = "pallas",
+                          hist_reduce: str = "psum",
+                          deterministic: bool = False):
     """Multi-leaf wave histogram pass distributed over the mesh row axis.
 
-    int8=True: the int8 x int8 -> int32 MXU kernel runs per shard and the
-    psum reduces INT32 histograms — exact integer accumulation across the
-    mesh, the collective analog of the reference's quantized histogram
-    reduction (ref: data_parallel_tree_learner.cpp:290-297, which reduces
-    packed integer bins instead of floats). Callers dequantize AFTER the
-    reduce, so cross-shard sums are exact multiples of the grad/hess
-    scales.
+    int8=True: the int8 x int8 -> int32 kernel (MXU pallas where Mosaic
+    runs, its exact-integer XLA twin for impl="xla") runs per shard and
+    the reduce moves INT32 histograms — exact integer accumulation
+    across the mesh, the collective analog of the reference's quantized
+    histogram reduction (ref: data_parallel_tree_learner.cpp:290-297,
+    which reduces packed integer bins instead of floats). Callers
+    dequantize AFTER the reduce, so cross-shard sums are exact
+    multiples of the grad/hess scales.
+
+    hist_reduce="scatter": ``psum_scatter`` over the (zero-padded)
+    feature axis instead of the full psum — each shard receives only
+    its owned feature slice (ReduceScatter,
+    data_parallel_tree_learner.cpp:287) and the result stays
+    feature-sharded for the scatter split stage. Hierarchical
+    ("dcn", "ici") meshes scatter over ICI and psum the owned slice
+    over DCN (see _sharded_pallas_build).
     """
     from jax.sharding import PartitionSpec as P
-    from .ops.pallas_histogram import hist_pallas_multi, \
-        hist_pallas_multi_int8
-    axis = shard_mesh.axis_names[0]
+    from .ops.pallas_histogram import (hist_pallas_multi,
+                                       hist_pallas_multi_int8,
+                                       hist_multi, hist_multi_int8)
+    axes = tuple(shard_mesh.axis_names)
+    row_axes = axes if len(axes) > 1 else axes[0]
+    scat_axis = axes[-1]
+    width = int(shard_mesh.shape[scat_axis])
+    scatter = hist_reduce == "scatter"
 
     def local(b_l, ghT_l, rl_l, ids):
-        if int8:
-            h = hist_pallas_multi_int8(b_l, ghT_l, rl_l, ids,
-                                       max_bins=max_bins,
-                                       num_slots=ids.shape[0])
+        if impl == "pallas":
+            if int8:
+                h = hist_pallas_multi_int8(b_l, ghT_l, rl_l, ids,
+                                           max_bins=max_bins,
+                                           num_slots=ids.shape[0])
+            else:
+                h = hist_pallas_multi(b_l, ghT_l, rl_l, ids,
+                                      max_bins=max_bins,
+                                      num_slots=ids.shape[0],
+                                      precise=precision)
+        elif int8:
+            # per-shard exact-integer XLA twin of the MXU kernel
+            h = hist_multi_int8(b_l, ghT_l, rl_l, ids, max_bins=max_bins,
+                                num_slots=ids.shape[0], impl=impl)
         else:
-            h = hist_pallas_multi(b_l, ghT_l, rl_l, ids, max_bins=max_bins,
-                                  num_slots=ids.shape[0], precise=precision)
-        return obs_health.psum(h, axis, tag="hist/psum_wave")
+            h = hist_multi(b_l, ghT_l, rl_l, ids, max_bins=max_bins,
+                           num_slots=ids.shape[0], impl=impl,
+                           precision=precision,
+                           deterministic=deterministic)
+        if not scatter:
+            return obs_health.psum(h, row_axes, tag="hist/psum_wave")
+        fpad = (-h.shape[1]) % width
+        if fpad:
+            h = jnp.pad(h, ((0, 0), (0, fpad), (0, 0), (0, 0)))
+        # ReduceScatter over the feature axis: INT32 payloads on the
+        # int8 path stay exact under any reduction grouping
+        h = obs_health.psum_scatter(h, scat_axis,
+                                    tag="hist/psum_scatter",
+                                    scatter_dimension=1)
+        if len(axes) > 1:
+            h = obs_health.psum(h, axes[:-1], tag="hist/psum_dcn")
+        return h
 
     from .parallel.mesh import shard_map as _shard_map
     fn = _shard_map(local, mesh=shard_mesh,
-                    in_specs=(P(None, axis), P(axis, None), P(axis), P()),
-                    out_specs=P())
+                    in_specs=(P(None, row_axes), P(row_axes, None),
+                              P(row_axes), P()),
+                    out_specs=(P(None, scat_axis, None, None) if scatter
+                               else P()))
 
     def multi(bins, ghT, row_leaf, ids):
         # padded rows: leaf id -1 matches no slot (slots are >= 0 or the
@@ -282,6 +358,7 @@ def grow_tree(bins_fm: jax.Array,
               num_bundle_bins: int = 0,
               mono_pairwise: bool = False,
               shard_mesh=None,
+              hist_reduce: str = "psum",
               sparse_shape=None,
               hist_deterministic: bool = False):
     """Grow one leaf-wise tree. Returns (TreeArrays, row_leaf [N] int32).
@@ -296,6 +373,17 @@ def grow_tree(bins_fm: jax.Array,
     shard_map (pallas_call does not auto-partition under GSPMD) and are
     psum-reduced — the device analog of HistogramSumReducer
     (ref: data_parallel_tree_learner.cpp:287-297).
+
+    hist_reduce: "psum" all-reduces full histograms (the A/B oracle);
+    "scatter" reduce-scatters them over a static feature partition —
+    each shard owns 1/W of the (zero-padded) feature axis, best-split
+    search runs feature-sharded (parallel/scatter.py keeps it at the
+    oracle's tensor shape for bit-parity) and per-shard winners combine
+    through one tiny SplitInfo all_gather + argmax
+    (ref: data_parallel_tree_learner.cpp:287-297 ReduceScatter +
+    FindBestSplitsFromHistograms + SyncUpGlobalBestSplit). Demoted to
+    psum when there is no multi-device mesh or the storage is
+    EFB-bundled / COO-sparse (those builds don't run under shard_map).
 
     mono_pairwise: use the exact pairwise leaf-box monotone bounds
     (monotone_constraints_method intermediate/advanced — see
@@ -321,17 +409,22 @@ def grow_tree(bins_fm: jax.Array,
     L = num_leaves
     f32 = hist_dtype
 
+    use_mesh = shard_mesh is not None and shard_mesh.size > 1
+    if (not use_mesh or bundle is not None or sparse_shape is not None):
+        hist_reduce = "psum"
+
     build_bins = max_bins if bundle is None else num_bundle_bins
     if sparse_shape is not None:
         assert bundle is None, "sparse COO storage is not bundled"
         build = functools.partial(
             hist_ops.build_histogram_sparse,
             num_features=num_features, max_bins=max_bins, dtype=f32)
-    elif shard_mesh is not None and shard_mesh.size > 1 and \
-            hist_impl == "pallas":
+    elif use_mesh and (hist_impl == "pallas" or hist_reduce == "scatter"):
         raw_build = _sharded_pallas_build(
             shard_mesh, max_bins=build_bins, dtype=f32,
-            row_chunk=row_chunk, precision=hist_precision)
+            row_chunk=row_chunk, precision=hist_precision,
+            impl=hist_impl, hist_reduce=hist_reduce,
+            deterministic=hist_deterministic)
     else:
         raw_build = functools.partial(
             hist_ops.build_histogram, max_bins=build_bins, dtype=f32,
@@ -368,12 +461,32 @@ def grow_tree(bins_fm: jax.Array,
     root_fmask = feature_mask if root_allowed is None else \
         feature_mask & root_allowed
     neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
+
+    if hist_reduce == "scatter":
+        # feature-sharded split search + SplitInfo winner all_gather —
+        # root gathers once, the scan-body sites gather L-1 times each
+        from .parallel.scatter import make_scatter_split
+        _scat_kw = dict(num_features=num_features,
+                        hist_features=root_hist.shape[0],
+                        has_categorical=has_categorical, batched=False)
+        split_root_fn = make_scatter_split(shard_mesh, loop_factor=1,
+                                           **_scat_kw)
+        split_step_fn = make_scatter_split(shard_mesh,
+                                           loop_factor=max(L - 1, 1),
+                                           **_scat_kw)
+    else:
+        def _split_plain(hist, pg, ph, pc, meta_, hp_, fm, parent_out,
+                         min_b, max_b, depth, rand_bins=None):
+            return find_best_split(hist, pg, ph, pc, meta_, hp_, fm,
+                                   parent_out, min_b, max_b, depth,
+                                   has_categorical, rand_bins)
+        split_root_fn = split_step_fn = _split_plain
+
     rb_root, fm_root = _node_randomness(node_key, 0, meta, root_fmask,
                                         extra_trees, ff_bynode)
-    root_split = find_best_split(root_hist, root_g, root_h, root_c,
-                                 meta, hp, fm_root, root_out,
-                                 neg_inf, pos_inf, jnp.int32(0),
-                                 has_categorical, rb_root)
+    root_split = split_root_fn(root_hist, root_g, root_h, root_c,
+                               meta, hp, fm_root, root_out,
+                               neg_inf, pos_inf, jnp.int32(0), rb_root)
 
     zero_l = jnp.zeros((L,), f32)
     leaves = _LeafSplits(
@@ -393,8 +506,10 @@ def grow_tree(bins_fm: jax.Array,
     leaves = _store_split(leaves, 0, root_split, jnp.int32(1), root_out,
                           root_g, root_h, root_c, neg_inf, pos_inf, True)
 
-    pool = jnp.zeros((L, num_features, max_bins, hist_ops.NUM_HIST_CHANNELS),
-                     f32)
+    # pool shape follows the built histogram: [F, B, 3] replicated, or
+    # the zero-padded [Fp, B, 3] feature-sharded slab in scatter mode
+    # (GSPMD propagates the feature sharding through the pool updates)
+    pool = jnp.zeros((L,) + tuple(root_hist.shape), f32)
     pool = pool.at[0].set(root_hist)
 
     state = _GrowState(
@@ -562,12 +677,12 @@ def grow_tree(bins_fm: jax.Array,
                                       child_fmask, extra_trees, ff_bynode)
         rb_r, fm_r = _node_randomness(node_key, 2 * step_idx + 3, meta,
                                       child_fmask, extra_trees, ff_bynode)
-        split_l = find_best_split(left_hist, lg, lh, lc, meta, hp,
-                                  fm_l, out_l, l_min, l_max,
-                                  pen_depth, has_categorical, rb_l)
-        split_r = find_best_split(right_hist, rg, rh, rc, meta, hp,
-                                  fm_r, out_r, r_min, r_max,
-                                  pen_depth, has_categorical, rb_r)
+        split_l = split_step_fn(left_hist, lg, lh, lc, meta, hp,
+                                fm_l, out_l, l_min, l_max,
+                                pen_depth, rb_l)
+        split_r = split_step_fn(right_hist, rg, rh, rc, meta, hp,
+                                fm_r, out_r, r_min, r_max,
+                                pen_depth, rb_r)
         # depth cap (ref: serial_tree_learner.cpp max_depth check)
         depth_ok = (max_depth <= 0) | (child_depth < max_depth)
         split_l = split_l._replace(
@@ -731,6 +846,69 @@ def hist_traffic_model(*, num_data: int, storage_features: int,
     }
 
 
+def collective_traffic_model(*, num_features: int, max_bins: int,
+                             num_leaves: int, wave_max: int, width: int,
+                             reduction: str = "psum", dcn: int = 1,
+                             slots: int = HIST_SLOTS,
+                             subtract: bool = True, waved: bool = True):
+    """Static per-iteration COLLECTIVE traffic model of the mesh grower
+    — the byte counterpart of ``hist_traffic_model`` for what crosses
+    the interconnect rather than HBM. Exact for the compiled program:
+    wave schedule, feature padding and payload record sizes are all
+    trace-time constants, and the runtime ``collectives`` counters use
+    the same per-shard-result byte convention (obs/health.py), so model
+    and counters agree by construction.
+
+    reduction="psum": every histogram pass all-reduces the full
+    [S, F, B, 3] slab (per-shard result bytes = the full slab).
+    reduction="scatter": each pass reduce-scatters the zero-padded
+    [S, Fp, B, 3] slab over ``width`` shards (per-shard result = 1/W of
+    it) and every split-search batch all_gathers ``width`` SplitInfo
+    records per tree position — O(W * sizeof(SplitInfo)), not
+    O(F * B). With ``dcn`` > 1 (hierarchical mesh) the owned 1/W slice
+    additionally psums over the slow inter-host link: ``dcn_bytes``
+    prices that leg separately since DCN bandwidth, not ICI, is the
+    multi-host ceiling.
+
+    width: shards on the scatter (last, ICI) mesh axis; dcn: process
+    groups on the outer axis (1 = flat single-host mesh)."""
+    from .ops.split import split_info_nbytes
+
+    f_pad = -(-num_features // max(width, 1)) * max(width, 1)
+    if waved:
+        sizes = _wave_schedule(num_leaves, wave_max, slots,
+                               1 if subtract else 2)
+        # root pass + one boundary per wave (the last is skipped);
+        # boundary passes build S (or 2S) slots and search 2S children
+        hist_slots = [1] + [(s if subtract else 2 * s)
+                            for s in sizes[:-1]]
+        search_records = 1 + 2 * sum(sizes[:-1])
+    else:
+        hist_slots = [1] * num_leaves  # root + smaller child per split
+        search_records = 1 + 2 * (num_leaves - 1)
+    slab = max_bins * 3 * 4  # one feature row: [B, 3] x 4-byte elems
+    if reduction == "psum":
+        hist_bytes = sum(hist_slots) * num_features * slab
+        split_bytes = 0
+        dcn_bytes = 0
+    else:
+        hist_bytes = sum(hist_slots) * (f_pad // max(width, 1)) * slab
+        split_bytes = search_records * width * split_info_nbytes(max_bins)
+        dcn_bytes = (hist_bytes if dcn > 1 else 0)
+    return {
+        "reduction": reduction,
+        "width": width,
+        "dcn": dcn,
+        "padded_features": f_pad,
+        "hist_collective_bytes_per_iter": hist_bytes,
+        "split_collective_bytes_per_iter": split_bytes,
+        "dcn_bytes_per_iter": dcn_bytes,
+        "collective_bytes_per_iter": hist_bytes + split_bytes + dcn_bytes,
+        "split_records_per_iter": search_records,
+        "split_info_nbytes": split_info_nbytes(max_bins),
+    }
+
+
 def _wave_step_stored(carry, step_idx, *, L, meta, hp, unknown,
                       mono_pairwise, partition_fn=None):
     """One stored-candidate split application (no histogram builds) —
@@ -847,18 +1025,28 @@ def _unknown_split(max_bins: int) -> SplitInfo:
 def _init_wave_state(root_hist, root_g, root_h, root_c, meta, hp,
                      root_fmask, node_key, *, L, max_bins, num_features,
                      f32, has_categorical, extra_trees, ff_bynode,
-                     interaction_groups):
+                     interaction_groups, split_fn=None):
     """Root leaf state + histogram pool from a built root histogram —
     shared by the resident waved grower and the streamed grower (the
-    streamed root histogram arrives accumulated over slabs)."""
+    streamed root histogram arrives accumulated over slabs).
+
+    split_fn: optional find_best_split replacement (signature minus
+    has_categorical) — the feature-sharded scatter search
+    (parallel/scatter.py). The pool then inherits the (possibly
+    feature-padded) built histogram's shape."""
     neg_inf, pos_inf = jnp.float32(-jnp.inf), jnp.float32(jnp.inf)
     root_out = leaf_output(root_g, root_h, hp)
     rb_root, fm_root = _node_randomness(node_key, 0, meta, root_fmask,
                                         extra_trees, ff_bynode)
-    root_split = find_best_split(root_hist, root_g, root_h, root_c,
-                                 meta, hp, fm_root, root_out,
-                                 neg_inf, pos_inf, jnp.int32(0),
-                                 has_categorical, rb_root)
+    if split_fn is None:
+        root_split = find_best_split(root_hist, root_g, root_h, root_c,
+                                     meta, hp, fm_root, root_out,
+                                     neg_inf, pos_inf, jnp.int32(0),
+                                     has_categorical, rb_root)
+    else:
+        root_split = split_fn(root_hist, root_g, root_h, root_c,
+                              meta, hp, fm_root, root_out,
+                              neg_inf, pos_inf, jnp.int32(0), rb_root)
 
     zero_l = jnp.zeros((L,), f32)
     leaves = _LeafSplits(
@@ -877,8 +1065,7 @@ def _init_wave_state(root_hist, root_g, root_h, root_c, meta, hp,
     )
     leaves = _store_split(leaves, 0, root_split, jnp.int32(1), root_out,
                           root_g, root_h, root_c, neg_inf, pos_inf, True)
-    pool = jnp.zeros((L, num_features, max_bins,
-                      hist_ops.NUM_HIST_CHANNELS), f32)
+    pool = jnp.zeros((L,) + tuple(root_hist.shape), f32)
     pool = pool.at[0].set(root_hist)
     used = (jnp.zeros((L, num_features), bool)
             if interaction_groups is not None else None)
@@ -889,13 +1076,17 @@ def _wave_boundary_core(pool, leaves, used_features, ys, wave_hists,
                         feature_mask, max_depth, node_key, s0, *,
                         subtract_siblings, L, num_features, f32, meta, hp,
                         interaction_groups, has_categorical, extra_trees,
-                        ff_bynode):
+                        ff_bynode, split_fn=None):
     """Wave-boundary histogram bookkeeping + child candidate search,
     given the wave's built histograms (`wave_hists`: the W smaller
     children under subtraction, or both-children [2W] in oracle mode).
     Shared by the resident waved grower (which builds wave_hists with
     one resident multi-leaf pass) and the streamed grower (which
-    accumulates them over host-fed slabs)."""
+    accumulates them over host-fed slabs).
+
+    split_fn: optional BATCHED find_best_split replacement taking the
+    [2W]-leading child histograms/stats (the feature-sharded scatter
+    search); None runs the stock replicated vmap."""
     W = ys["valid"].shape[0]
     if subtract_siblings:
         parents = pool[ys["left_id"]]                      # [W, F, B, 3]
@@ -933,8 +1124,26 @@ def _wave_boundary_core(pool, leaves, used_features, ys, wave_hists,
     else:
         fmask_c = jnp.broadcast_to(feature_mask, (2 * W, num_features))
     salts = 2 * s0 + jnp.arange(2 * W, dtype=jnp.int32)
-    infos = jax.vmap(child_candidates, in_axes=(0, 0, 0, 0, None))(
-        hists, child_ids, fmask_c, salts, leaves)
+    if split_fn is None:
+        infos = jax.vmap(child_candidates, in_axes=(0, 0, 0, 0, None))(
+            hists, child_ids, fmask_c, salts, leaves)
+    else:
+        # same per-node randomness as the vmapped oracle, then ONE
+        # batched feature-sharded search over the 2W children
+        if node_key is None:
+            rbs, fms = None, fmask_c
+        else:
+            rbs, fms = jax.vmap(
+                lambda s, f: _node_randomness(node_key, s, meta, f,
+                                              extra_trees, ff_bynode))(
+                salts, fmask_c)
+        infos = split_fn(hists, leaves.sum_grad[child_ids],
+                         leaves.sum_hess[child_ids],
+                         leaves.count[child_ids], meta, hp, fms,
+                         leaves.output[child_ids],
+                         leaves.min_bound[child_ids],
+                         leaves.max_bound[child_ids],
+                         leaves.depth[child_ids] - 1, rbs)
     depth_ok = (max_depth <= 0) | (leaves.depth[child_ids] < max_depth)
     gains = jnp.where(child_valid & depth_ok, infos.gain, K_MIN_SCORE)
 
@@ -985,6 +1194,7 @@ def grow_tree_waved(bins_fm: jax.Array,
                     num_bundle_bins: int = 0,
                     mono_pairwise: bool = False,
                     shard_mesh=None,
+                    hist_reduce: str = "psum",
                     sparse_shape=None,
                     batched_partition=None,
                     fused_grad=None,
@@ -1062,8 +1272,13 @@ def grow_tree_waved(bins_fm: jax.Array,
     SLOTS = HIST_SLOTS  # 128 MXU columns // 3 channels
     build_bins = max_bins if bundle is None else num_bundle_bins
 
-    use_shard_hist = (shard_mesh is not None and shard_mesh.size > 1
-                      and hist_impl == "pallas")
+    use_mesh = shard_mesh is not None and shard_mesh.size > 1
+    if (not use_mesh or bundle is not None or sparse_shape is not None):
+        # scatter needs shard_map histogram builds over the raw bins;
+        # EFB/COO storage builds don't run there — psum oracle instead
+        hist_reduce = "psum"
+    use_shard_hist = use_mesh and (hist_impl == "pallas"
+                                   or hist_reduce == "scatter")
     use_kernel_fused = False
     if fused_grad is not None:
         assert quant is None and sparse_shape is None, \
@@ -1100,7 +1315,9 @@ def grow_tree_waved(bins_fm: jax.Array,
             # histogram reduction (data_parallel_tree_learner.cpp:290)
             _multi_i32 = _sharded_pallas_multi(
                 shard_mesh, max_bins=build_bins,
-                precision=hist_precision, int8=True)
+                precision=hist_precision, int8=True, impl=hist_impl,
+                hist_reduce=hist_reduce,
+                deterministic=hist_deterministic)
 
             def multi_raw(bins, ghT_unused, row_leaf, ids):
                 return _multi_i32(bins, ghT_i8, row_leaf,
@@ -1127,7 +1344,8 @@ def grow_tree_waved(bins_fm: jax.Array,
     elif use_shard_hist:
         multi_raw = _sharded_pallas_multi(
             shard_mesh, max_bins=build_bins, precision=hist_precision,
-            int8=False)
+            int8=False, impl=hist_impl, hist_reduce=hist_reduce,
+            deterministic=hist_deterministic)
     else:
         def multi_raw(bins, ghT_, row_leaf, ids):
             # num_slots = the wave's LIVE count: the pallas kernel's cost
@@ -1173,11 +1391,25 @@ def grow_tree_waved(bins_fm: jax.Array,
     root_c = jnp.sum(sample_mask, dtype=f32)
     root_fmask = feature_mask if root_allowed is None else \
         feature_mask & root_allowed
+    if hist_reduce == "scatter":
+        from .parallel.scatter import make_scatter_split
+        _scat_kw = dict(num_features=num_features,
+                        hist_features=root_hist.shape[0],
+                        has_categorical=has_categorical)
+        split_root_fn = make_scatter_split(shard_mesh, batched=False,
+                                           **_scat_kw)
+        # one batched search per wave boundary: [2W] children gather as
+        # ONE all_gather of 2W SplitInfo records per shard
+        split_wave_fn = make_scatter_split(shard_mesh, batched=True,
+                                           **_scat_kw)
+    else:
+        split_root_fn = split_wave_fn = None
     leaves, pool, used_features = _init_wave_state(
         root_hist, root_g, root_h, root_c, meta, hp, root_fmask, node_key,
         L=L, max_bins=max_bins, num_features=num_features, f32=f32,
         has_categorical=has_categorical, extra_trees=extra_trees,
-        ff_bynode=ff_bynode, interaction_groups=interaction_groups)
+        ff_bynode=ff_bynode, interaction_groups=interaction_groups,
+        split_fn=split_root_fn)
     row_leaf = jnp.zeros((num_data,), jnp.int32)
 
     unknown = _unknown_split(max_bins)
@@ -1284,7 +1516,7 @@ def grow_tree_waved(bins_fm: jax.Array,
             num_features=num_features, f32=f32, meta=meta, hp=hp,
             interaction_groups=interaction_groups,
             has_categorical=has_categorical, extra_trees=extra_trees,
-            ff_bynode=ff_bynode)
+            ff_bynode=ff_bynode, split_fn=split_wave_fn)
 
     records = jax.tree_util.tree_map(
         lambda *xs: jnp.concatenate(xs, axis=0), *all_records)

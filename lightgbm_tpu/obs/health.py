@@ -253,7 +253,8 @@ class HealthRegistry:
         # reduced output is the local slice, all_gather's is W x it —
         # so the derived seconds-per-byte rate prices runtime bytes
         # consistently in _estimate_collective_share
-        op_bytes = {"psum": payload_rows * 4, "all_gather": n * 4}
+        op_bytes = {"psum": payload_rows * 4, "all_gather": n * 4,
+                    "psum_scatter": payload_rows * 4 // mesh.size}
         out: Dict[str, Any] = {}
         with global_tracer.span("health/collective_probe"):
             for op, (fn, x) in programs.items():
@@ -301,11 +302,18 @@ class HealthRegistry:
         def _gather(v):
             return lax.all_gather(v, axis)
 
+        def _scatter(v):
+            return lax.psum_scatter(v, axis, scatter_dimension=0,
+                                    tiled=True)
+
         cached = {
             "psum": (jax.jit(_shard_map(
                 _psum, mesh=mesh, in_specs=P(axis), out_specs=P())), x),
             "all_gather": (jax.jit(_shard_map(
                 _gather, mesh=mesh, in_specs=P(axis),
+                out_specs=P(axis))), x),
+            "psum_scatter": (jax.jit(_shard_map(
+                _scatter, mesh=mesh, in_specs=P(axis),
                 out_specs=P(axis))), x),
         }
         self._digest_cache[key] = cached
@@ -668,3 +676,31 @@ def all_gather(x, axis_name: str, *, tag: str, loop_factor: int = 1):
     global_metrics.note_collective("all_gather", nbytes)
     global_health.register_site(tag, "all_gather", nbytes, loop_factor)
     return out
+
+
+def psum_scatter(x, axis_name: str, *, tag: str, loop_factor: int = 1,
+                 scatter_dimension: int = 0):
+    """``lax.psum_scatter`` (tiled) with health accounting: each shard
+    receives only its owned 1/W slice of the reduced tensor — the
+    ReduceScatter of data_parallel_tree_learner.cpp:287. Byte counts
+    are of the per-shard RESULT slice (the wrapper convention), which
+    is what makes the psum->psum_scatter reduction visible as a W-fold
+    drop in the runtime counters."""
+    from jax import lax
+    out = lax.psum_scatter(x, axis_name,
+                           scatter_dimension=scatter_dimension, tiled=True)
+    nbytes = _tree_bytes(out)
+    global_metrics.note_collective("psum_scatter", nbytes)
+    global_health.register_site(tag, "psum_scatter", nbytes, loop_factor)
+    return out
+
+
+def note_gspmd_collective(op: str, nbytes: int, *, tag: str,
+                          loop_factor: int = 1) -> None:
+    """Account a collective the XLA GSPMD partitioner inserts on its own
+    (no lax call site to wrap — e.g. the reduce-scatter materializing a
+    feature-sharded histogram constraint). Called at trace time from
+    inside the instrumented program so the modeled bytes land in the
+    same manifest/runtime counters as the explicit wrappers."""
+    global_metrics.note_collective(op, int(nbytes))
+    global_health.register_site(tag, op, int(nbytes), loop_factor)

@@ -122,6 +122,17 @@ class SplitInfo(NamedTuple):
     cat_mask: jax.Array      # [B] bool, bins going left (categorical)
 
 
+def split_info_nbytes(max_bins: int) -> int:
+    """Wire size of ONE SplitInfo record: 11 four-byte scalar fields
+    (gain, feature, threshold, 6 child sums, 2 outputs) + the
+    default_left bool + the [max_bins] bool cat_mask. This is the
+    all_gather payload unit of the reduce-scatter learner's winner
+    sync (ref: SyncUpGlobalBestSplit ships sizeof(SplitInfo) per
+    machine, data_parallel_tree_learner.cpp:297) — O(bytes) per split,
+    vs O(F * B) for a full histogram row."""
+    return 11 * 4 + 1 + max_bins
+
+
 def threshold_l1(s: jax.Array, l1: jax.Array) -> jax.Array:
     """Soft-threshold by lambda_l1 (ref: feature_histogram.hpp ThresholdL1)."""
     return jnp.sign(s) * jnp.maximum(jnp.abs(s) - l1, 0.0)

@@ -60,22 +60,38 @@ class _DataParallelMixin:
                 self._setup_multihost()
             return
         if self.num_data % max(self.mesh.size, 1) != 0:
-            # NamedSharding needs equal shards. Row tensors stay
-            # replicated; the pallas histogram path still distributes its
-            # passes (the shard_map wrapper pads rows to a mesh multiple
-            # internally, learner._pad_rows), the XLA path degrades to a
-            # replicated program.
+            # NamedSharding needs equal shards. Eligible learners pad the
+            # row tensors with masked rows to the next mesh multiple and
+            # keep storage FULLY SHARDED (pad rows carry sample_mask 0,
+            # so they contribute no statistics; every host consumer
+            # slices back to the real row count). Configurations whose
+            # row state can't be padded uniformly fall back to
+            # replicated row tensors: the pallas histogram path still
+            # distributes its passes (the shard_map wrapper pads rows
+            # internally, learner._pad_rows), the XLA path degrades to
+            # a replicated program.
             import warnings
+            if self.mesh.size > 1 and self._row_pad_eligible():
+                self._pad_and_shard_rows()
+                self.feature_meta = jax.tree_util.tree_map(
+                    lambda a: mesh_lib.replicate(self.mesh, a),
+                    self.feature_meta)
+                self._build_grow_sharded()
+                return
             warnings.warn(
                 f"num_data={self.num_data} is not divisible by the "
-                f"{self.mesh.size}-device mesh; row tensors are kept "
-                "replicated (pad the dataset to a mesh multiple for "
-                "fully sharded storage)")
+                f"{self.mesh.size}-device mesh and this configuration "
+                "cannot pad row state; row tensors are kept replicated "
+                "(pad the dataset to a mesh multiple for fully sharded "
+                "storage)")
             self.feature_meta = jax.tree_util.tree_map(
                 lambda a: mesh_lib.replicate(self.mesh, a),
                 self.feature_meta)
             if self.mesh.size > 1:
-                self._build_grow_sharded()
+                # scatter needs genuinely row-sharded builds (replicated
+                # rows would change the psum-oracle's accumulation
+                # grouping and break bit-parity) — force the psum path
+                self._build_grow_sharded(scatter_ok=False)
             return
         if self._stream is not None:
             # out-of-core streaming: bins stay HOST-resident; only the
@@ -105,15 +121,91 @@ class _DataParallelMixin:
         if self.mesh.size > 1:
             self._build_grow_sharded()
 
-    def _build_grow_sharded(self):
+    def _row_pad_eligible(self) -> bool:
+        """Whether this learner's ROW state can be uniformly padded to a
+        mesh multiple (the non-divisible satellite of the reduce-scatter
+        learner). Conservative: plain GBDT with a built-in pointwise
+        objective only — ranking objectives hold query-shaped state,
+        linear trees / streaming / COO run host-side row logic, and
+        DART/RF mutate scores outside the guarded jit paths."""
+        if getattr(self, "boosting_type", "") != "gbdt":
+            return False
+        if self.objective is None or getattr(self.objective,
+                                             "is_ranking", False):
+            return False
+        if self.config.linear_tree:
+            return False
+        if self._stream is not None or self._sparse_shape is not None:
+            return False
+        bins = self.bins_fm
+        return (isinstance(bins, jax.Array) and bins.ndim == 2
+                and bins.shape[1] == self.num_data)
+
+    def _pad_and_shard_rows(self) -> None:
+        """Pad every row-indexed device tensor with masked rows to the
+        next mesh multiple and shard it — `self.num_data` keeps the REAL
+        row count and `self._row_pad` records the tail, which the
+        sampling/quantization draws and the host-facing score reads
+        respect (boosting.py guards). Pad rows carry sample_mask 0 and
+        zero bins, so they contribute nothing to any statistic."""
+        import warnings
+        mult = int(self.mesh.size)
+        pad = (-self.num_data) % mult
+        warnings.warn(
+            f"num_data={self.num_data} is not divisible by the "
+            f"{mult}-device mesh; padding row tensors with {pad} masked "
+            "rows to keep storage fully sharded")
+        self._row_pad = pad
+        self.bins_fm = mesh_lib.shard_data(
+            self.mesh, jnp.pad(jnp.asarray(self.bins_fm),
+                               ((0, 0), (0, pad))), row_axis=1)
+        self.scores = mesh_lib.shard_data(
+            self.mesh, jnp.pad(jnp.asarray(self.scores),
+                               ((0, 0), (0, pad))), row_axis=1)
+        self._sample_mask = mesh_lib.shard_data(
+            self.mesh, jnp.pad(jnp.asarray(self._sample_mask),
+                               (0, pad)), row_axis=0)
+        # objective device buffers, same shape dispatch as the
+        # multi-host assembly above: [N]-leading pads+shards on axis 0,
+        # [.., N] on axis 1, everything else replicates
+        if self.objective is not None:
+            n = self.num_data
+            for name, arr in list(vars(self.objective).items()):
+                if not isinstance(arr, jax.Array):
+                    continue
+                if arr.ndim >= 1 and arr.shape[0] == n:
+                    cfg = [(0, pad)] + [(0, 0)] * (arr.ndim - 1)
+                    garr = mesh_lib.shard_data(
+                        self.mesh, jnp.pad(arr, cfg), row_axis=0)
+                elif arr.ndim >= 2 and arr.shape[1] == n:
+                    cfg = [(0, 0), (0, pad)] + [(0, 0)] * (arr.ndim - 2)
+                    garr = mesh_lib.shard_data(
+                        self.mesh, jnp.pad(arr, cfg), row_axis=1)
+                else:
+                    garr = mesh_lib.replicate(self.mesh, arr)
+                setattr(self.objective, name, garr)
+
+    def _build_grow_sharded(self, scatter_ok: bool = True):
         """pallas_call does not auto-partition under GSPMD, so the pallas
         histogram kernels run per-shard inside shard_map with an explicit
-        psum (learner._sharded_pallas_{build,multi}); the XLA one-hot
-        path instead partitions its contraction automatically."""
+        reduce (learner._sharded_pallas_{build,multi}); the XLA one-hot
+        path partitions its contraction automatically under psum, and
+        runs inside the same shard_map builders when the reduce-scatter
+        protocol is on (tpu_hist_reduce=scatter, parallel/scatter.py)."""
         from ..ops import histogram as hist_ops
+        from .scatter import resolve_hist_reduce
         impl = hist_ops.resolve_impl(self.config.tpu_hist_impl)
+        hr = resolve_hist_reduce(self.config.tpu_hist_reduce, self.mesh,
+                                 int(self.train_set.num_features))
+        if not scatter_ok or self._stream is not None or \
+                self._sparse_shape is not None:
+            hr = "psum"
         if impl == "pallas":
-            self._build_grow("pallas", shard_mesh=self.mesh)
+            self._build_grow("pallas", shard_mesh=self.mesh,
+                             hist_reduce=hr)
+        elif hr == "scatter":
+            self._build_grow("xla", shard_mesh=self.mesh,
+                             hist_reduce="scatter")
         else:
             self._build_grow("xla")
 
@@ -248,11 +340,14 @@ class VotingParallelGBDT(_DataParallelMixin, GBDT):
             import warnings
             warnings.warn("forced splits / interaction constraints are "
                           "not supported by tree_learner=voting; ignoring")
-        if self.mesh.size > 1 and self.num_data % self.mesh.size != 0:
+        if self.mesh.size > 1 and self.num_data % self.mesh.size != 0 \
+                and getattr(self, "_row_pad", 0) == 0:
             # the voting grower's shard_map shards rows over the mesh,
-            # which needs equal slices; the data-parallel grower the
-            # mixin already installed handles this case (its pallas
-            # wrapper pads internally, its XLA path runs replicated)
+            # which needs equal slices; padded-row storage (see
+            # _pad_and_shard_rows) already provides them, and otherwise
+            # the data-parallel grower the mixin installed handles this
+            # case (its pallas wrapper pads internally, its XLA path
+            # runs replicated)
             import warnings
             warnings.warn(
                 f"tree_learner=voting needs num_data divisible by the "
@@ -266,16 +361,23 @@ class VotingParallelGBDT(_DataParallelMixin, GBDT):
                     "extra_trees / feature_fraction_bynode are not "
                     "supported by the sharded voting learner; ignoring")
             from ..ops import histogram as hist_ops
+            from .scatter import resolve_hist_reduce
             from .voting import make_sharded_voting_grow
             top_k = max(1, min(int(config.top_k),
                                self.train_set.num_features))
             static = dict(self._static)
+            # voting scatters over its top-candidate axis and pads it
+            # internally, so auto takes scatter for ANY feature count
             grow = make_sharded_voting_grow(
                 self.mesh, top_k=top_k,
                 hist_impl=("xla" if config.deterministic_hist else
                            hist_ops.resolve_impl(config.tpu_hist_impl)),
                 hist_deterministic=bool(config.deterministic_hist),
-                has_categorical=self._has_categorical, **static)
+                has_categorical=self._has_categorical,
+                hist_reduce=resolve_hist_reduce(
+                    config.tpu_hist_reduce, self.mesh,
+                    self.train_set.num_features, pad_ok=True),
+                **static)
 
             def _grow_adapter(bins, g, h, m, fm, meta, hp, md,
                               forced=None, node_key=None):

@@ -33,20 +33,20 @@ from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams,
                          SplitInfo, find_best_split, leaf_output,
                          propagate_monotone_bounds)
 from . import mesh as mesh_lib
+from .scatter import allgather_argmax_best
 
 
 def _sync_best_split(info: SplitInfo, feat_offset, axis_name,
                      loop_factor: int = 1) -> SplitInfo:
     """All-gather per-shard winners, keep the globally best
     (ref: feature_parallel_tree_learner.cpp:63 SyncUpGlobalBestSplit).
-    loop_factor: static trip count of the enclosing scan, for the
-    health wrappers' runtime byte/call attribution."""
+    Shared combiner with the reduce-scatter learner (parallel/scatter.py);
+    this learner's feature indices are slice-local, so they shift to
+    global before the gather. loop_factor: static trip count of the
+    enclosing scan, for the health wrappers' byte/call attribution."""
     info = info._replace(feature=info.feature + feat_offset)
-    gathered = obs_health.all_gather(
-        info, axis_name, tag="split/all_gather",
-        loop_factor=loop_factor)  # each field [W]
-    winner = jnp.argmax(gathered.gain)
-    return jax.tree_util.tree_map(lambda x: x[winner], gathered)
+    return allgather_argmax_best(info, axis_name, tag="split/all_gather",
+                                 loop_factor=loop_factor)
 
 
 def grow_tree_feature_parallel(bins_fm, grad, hess, sample_mask,

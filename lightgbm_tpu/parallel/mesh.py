@@ -10,6 +10,10 @@ collectives that the reference implements by hand.
 Axis names:
   "data" — row (data-parallel) axis: the analog of
            DataParallelTreeLearner's machine axis (parallel_tree_learner.h:54).
+  "dcn"/"ici" — hierarchical data-parallel axes (get_hierarchical_mesh):
+           rows shard over BOTH; histogram reduce-scatter runs over the
+           fast in-process "ici" axis, and only each shard's owned
+           feature slice crosses the slow "dcn" (cross-process) axis.
 """
 
 from __future__ import annotations
@@ -61,11 +65,58 @@ def get_mesh(num_shards: int = 0, devices=None) -> Mesh:
     return _active_mesh
 
 
+DCN_AXIS = "dcn"
+ICI_AXIS = "ici"
+
+
+def get_hierarchical_mesh(devices=None,
+                          num_groups: int = 0) -> Mesh:
+    """2-D ("dcn", "ici") mesh for hierarchical reduce-scatter.
+
+    Groups devices by process (one "dcn" row per host, its local devices
+    along "ici"), matching the physical topology: ICI links within a
+    process, data-center network between processes. On a single process
+    ``num_groups`` can force an artificial split for testing. Row
+    sharding uses BOTH axes (shard_data handles tuple specs); the
+    learner's builders reduce-scatter over the last ("ici") axis and
+    psum the surviving 1/W slice over "dcn" — see
+    learner._sharded_pallas_multi and ISSUE/docs for the byte model.
+    """
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if num_groups and num_groups > 1:
+        groups = num_groups
+    else:
+        procs = sorted({d.process_index for d in devices})
+        groups = len(procs)
+        if groups > 1:
+            by_proc = {p: [d for d in devices if d.process_index == p]
+                       for p in procs}
+            per = min(len(v) for v in by_proc.values())
+            grid = np.asarray([by_proc[p][:per] for p in procs])
+            return Mesh(grid, (DCN_AXIS, ICI_AXIS))
+        groups = 1
+    if len(devices) % groups != 0:
+        raise ValueError(
+            f"{len(devices)} devices do not split into {groups} groups")
+    grid = np.asarray(devices).reshape(groups, -1)
+    return Mesh(grid, (DCN_AXIS, ICI_AXIS))
+
+
+def rows_spec(mesh: Mesh, ndim: int, row_axis: int = 0) -> P:
+    """PartitionSpec sharding `row_axis` over ALL mesh axes (1-D "data"
+    meshes and hierarchical ("dcn","ici") meshes alike)."""
+    names = mesh.axis_names
+    spec = [None] * ndim
+    spec[row_axis] = names[0] if len(names) == 1 else tuple(names)
+    return P(*spec)
+
+
 def shard_data(mesh: Mesh, array, row_axis: int):
-    """Place `array` sharded along its row dimension (rows over "data")."""
-    spec = [None] * array.ndim
-    spec[row_axis] = DATA_AXIS
-    sharding = NamedSharding(mesh, P(*spec))
+    """Place `array` sharded along its row dimension (rows over the mesh's
+    data axis, or over all axes of a hierarchical mesh)."""
+    sharding = NamedSharding(mesh, rows_spec(mesh, array.ndim, row_axis))
     return jax.device_put(array, sharding)
 
 

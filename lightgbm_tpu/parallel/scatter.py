@@ -1,0 +1,214 @@
+"""Reduce-scatter histogram aggregation + feature-sharded split search.
+
+The reference's data-parallel learner never all-reduces full histograms:
+it ReduceScatter-sums so each machine aggregates only a feature subset,
+finds its local best split there, and Allgathers ONE SplitInfo record
+(ref: data_parallel_tree_learner.cpp:287-297). This module is that
+protocol for the mesh growers:
+
+- ``resolve_hist_reduce`` maps the ``tpu_hist_reduce`` knob
+  (auto/psum/scatter) to the mode a given mesh + feature count runs;
+- ``make_scatter_split`` builds the shard_map'd split stage: each shard
+  holds its owned 1/W feature slice of the (already reduce-scattered)
+  histogram, embeds it at its GLOBAL feature offset in a zeros
+  [F, B, 3] tensor, masks ``feature_mask`` down to owned features, and
+  runs the stock ``ops/split.find_best_split``; per-shard winners then
+  combine through one tiny all_gather + argmax of SplitInfo records.
+
+Bit-parity contract (the ``tpu_hist_reduce=psum`` oracle stays
+available for A/B): ``lax.psum_scatter`` slices are bitwise equal to
+the matching rows of ``lax.psum`` (validated on CPU meshes, and exact
+by construction for the int32 quantized path), and the embed keeps the
+split-search arithmetic at the ORACLE's [F, B, V] shape and feature
+positions — computing gains on a [F/W, B, V] slice instead lets XLA
+pick a different cumsum/fma schedule and drifts gains by ~1 ulp.
+Non-owned features carry feature_mask=False, which ``_gain_tensors``
+maps to exactly K_MIN_SCORE, so the cross-shard argmax (first max ->
+lowest shard -> lowest global feature) reproduces the oracle's flat
+first-max tie-break over ordered disjoint slices.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..obs import health as obs_health
+from ..ops.split import find_best_split
+from .mesh import shard_map as _shard_map
+
+__all__ = [
+    "resolve_hist_reduce", "make_scatter_split", "allgather_argmax_best",
+    "scatter_axis",
+]
+
+
+def scatter_axis(shard_mesh):
+    """The mesh axis the feature partition lives on: the LAST axis.
+
+    1-D data meshes scatter over their only axis; hierarchical
+    ("dcn", "ici") meshes scatter over the fast in-process ICI axis and
+    psum the owned slice over the slow DCN axis (see
+    learner._sharded_pallas_multi), so split search and the winner
+    all_gather stay ICI-local.
+    """
+    return shard_mesh.axis_names[-1]
+
+
+def resolve_hist_reduce(knob: str, shard_mesh, num_features: int, *,
+                        pad_ok: bool = False) -> str:
+    """Map the ``tpu_hist_reduce`` knob to the mode this mesh runs.
+
+    auto: scatter when the mesh actually spans devices and the feature
+    count partitions evenly (``pad_ok`` callers — the voting learner,
+    which pads its candidate axis internally — take scatter for any
+    count); psum otherwise. Explicit scatter is honored even for uneven
+    counts (the builders zero-pad the feature axis to a mesh multiple).
+    """
+    if knob not in ("auto", "psum", "scatter"):
+        raise ValueError(
+            f"tpu_hist_reduce={knob!r}: expected auto, psum or scatter")
+    if shard_mesh is None or shard_mesh.size <= 1:
+        return "psum"
+    if knob != "auto":
+        return knob
+    width = shard_mesh.shape[scatter_axis(shard_mesh)]
+    if width <= 1:
+        return "psum"
+    return "scatter" if (pad_ok or num_features % width == 0) else "psum"
+
+
+def allgather_argmax_best(info, axis_name: str, *, tag: str,
+                          loop_factor: int = 1):
+    """All_gather per-shard SplitInfo winners and keep the best.
+
+    ``jnp.argmax`` takes the FIRST maximum, i.e. the lowest shard index
+    on exact ties — with ordered feature slices that is the lowest
+    global feature id, matching the replicated search's flat-argmax
+    tie-break (and the reference's SyncUpGlobalBestSplit,
+    feature_parallel_tree_learner.cpp:63).
+    """
+    gathered = obs_health.all_gather(info, axis_name, tag=tag,
+                                     loop_factor=loop_factor)
+    winner = jnp.argmax(gathered.gain)
+    return jax.tree_util.tree_map(lambda x: x[winner], gathered)
+
+
+def make_scatter_split(shard_mesh, *, num_features: int,
+                       hist_features: int, has_categorical: bool,
+                       batched: bool, loop_factor: int = 1):
+    """Shard_map'd best-split search over a feature-scattered histogram.
+
+    The returned callable mirrors ``find_best_split``'s signature with
+    meta/hp passed per call::
+
+        fn(hist, pg, ph, pc, meta, hp, fmask, parent_out, min_b, max_b,
+           depth, rand_bins)
+
+    ``hist`` is the reduce-scattered histogram — a GSPMD value whose
+    feature axis (axis 1 when ``batched``, else 0) is sharded over the
+    mesh's scatter axis at ``hist_features`` (= F zero-padded to a mesh
+    multiple) — and all other operands are replicated. ``batched`` runs
+    a leading S axis through ``jax.vmap`` exactly like the oracle
+    boundary search does (the vmapped kernel shape must match the
+    oracle's for bit-parity, see module docstring). Returns a
+    replicated SplitInfo (batched: [S]-leading) whose feature ids are
+    GLOBAL — the embed searches features at their true offsets, so no
+    post-hoc index shifting is needed.
+    """
+    axes = shard_mesh.axis_names
+    axis = axes[-1]
+    width = shard_mesh.shape[axis]
+    assert hist_features % width == 0, (hist_features, width)
+    f_local = hist_features // width
+    F = num_features
+
+    def _local(hist_loc, pg, ph, pc, meta, hp, fmask, parent_out,
+               min_b, max_b, depth, rand_bins):
+        idx = lax.axis_index(axis)
+        offset = idx * f_local
+        if batched:
+            S = hist_loc.shape[0]
+            full = jnp.zeros((S, hist_features) + hist_loc.shape[2:],
+                             hist_loc.dtype)
+            full = lax.dynamic_update_slice(
+                full, hist_loc,
+                (jnp.int32(0), offset) + (jnp.int32(0),) * (full.ndim - 2))
+            full = full[:, :F]
+        else:
+            full = jnp.zeros((hist_features,) + hist_loc.shape[1:],
+                             hist_loc.dtype)
+            full = lax.dynamic_update_slice(
+                full, hist_loc,
+                (offset,) + (jnp.int32(0),) * (full.ndim - 1))
+            full = full[:F]
+        owned = ((jnp.arange(F) >= offset)
+                 & (jnp.arange(F) < offset + f_local))
+        fm = fmask & (owned[None, :] if batched else owned)
+
+        if batched:
+            if rand_bins is None:
+                info = jax.vmap(
+                    lambda hh, a, b, c, f2, po, mn, mx, dp:
+                    find_best_split(hh, a, b, c, meta, hp, f2, po, mn,
+                                    mx, dp, has_categorical))(
+                    full, pg, ph, pc, fm, parent_out, min_b, max_b, depth)
+            else:
+                info = jax.vmap(
+                    lambda hh, a, b, c, f2, po, mn, mx, dp, rb:
+                    find_best_split(hh, a, b, c, meta, hp, f2, po, mn,
+                                    mx, dp, has_categorical, rb))(
+                    full, pg, ph, pc, fm, parent_out, min_b, max_b,
+                    depth, rand_bins)
+        else:
+            info = find_best_split(full, pg, ph, pc, meta, hp, fm,
+                                   parent_out, min_b, max_b, depth,
+                                   has_categorical, rand_bins)
+        return allgather_argmax_best_sliced(info, axis,
+                                            loop_factor=loop_factor,
+                                            batched=batched)
+
+    hist_spec = (P(None, axis, None, None) if batched
+                 else P(axis, None, None))
+    # two shard_map variants: extra-trees passes a rand_bins operand,
+    # everyone else passes None — a None leaf under a spec is fragile
+    # across shard_map implementations, so dispatch in python instead
+    fn_rb = _shard_map(
+        _local, mesh=shard_mesh,
+        in_specs=(hist_spec,) + (P(),) * 11,
+        out_specs=P())
+
+    def _local_norb(hist_loc, pg, ph, pc, meta, hp, fmask, parent_out,
+                    min_b, max_b, depth):
+        return _local(hist_loc, pg, ph, pc, meta, hp, fmask, parent_out,
+                      min_b, max_b, depth, None)
+
+    fn_norb = _shard_map(
+        _local_norb, mesh=shard_mesh,
+        in_specs=(hist_spec,) + (P(),) * 10,
+        out_specs=P())
+
+    def fn(hist, pg, ph, pc, meta, hp, fmask, parent_out, min_b, max_b,
+           depth, rand_bins=None):
+        if rand_bins is None:
+            return fn_norb(hist, pg, ph, pc, meta, hp, fmask,
+                           parent_out, min_b, max_b, depth)
+        return fn_rb(hist, pg, ph, pc, meta, hp, fmask, parent_out,
+                     min_b, max_b, depth, rand_bins)
+    return fn
+
+
+def allgather_argmax_best_sliced(info, axis_name: str, *,
+                                 loop_factor: int, batched: bool):
+    """Winner combine for (optionally [S]-batched) per-shard winners:
+    O(W * sizeof(SplitInfo)) on the wire, NOT O(L * F * B)."""
+    gathered = obs_health.all_gather(info, axis_name,
+                                     tag="split/allgather_best",
+                                     loop_factor=loop_factor)
+    if not batched:
+        winner = jnp.argmax(gathered.gain)
+        return jax.tree_util.tree_map(lambda x: x[winner], gathered)
+    S = gathered.gain.shape[1]
+    winner = jnp.argmax(gathered.gain, axis=0)          # [S]
+    sel = jnp.arange(S)
+    return jax.tree_util.tree_map(lambda x: x[winner, sel], gathered)

@@ -11,8 +11,14 @@ split drops from O(F * B) to O(W * k + 2k * B), the same bandwidth
 reduction PV-tree buys over plain data-parallel.
 
 Collectives used (all over ICI via shard_map):
-  psum      — root/candidate histogram reduction (HistogramSumReducer)
-  all_gather— top-k vote exchange (SyncUpGlobalBestSplit's Allgather)
+  psum        — root/candidate histogram reduction (HistogramSumReducer)
+  all_gather  — top-k vote exchange (SyncUpGlobalBestSplit's Allgather)
+  psum_scatter— hist_reduce="scatter": each shard reduces only its owned
+                slice of the candidate axis (ReduceScatter,
+                data_parallel_tree_learner.cpp:287) and searches it; one
+                SplitInfo all_gather + argmax picks the winner. Another
+                W-fold cut on the already-voted candidate traffic,
+                bit-identical to the psum path (see parallel/scatter.py).
 """
 
 from __future__ import annotations
@@ -36,6 +42,7 @@ from ..ops.split import (FeatureMeta, K_MIN_SCORE, SplitHyperParams,
                          find_best_split, leaf_output, per_feature_best_gain,
                          propagate_monotone_bounds)
 from . import mesh as mesh_lib
+from .scatter import allgather_argmax_best
 
 
 def _local_leaf_sums(local_hist: jax.Array):
@@ -48,7 +55,8 @@ def _local_leaf_sums(local_hist: jax.Array):
 def _vote_and_reduce(local_hist, pg, ph, pc, parent_out, min_b, max_b,
                      depth, meta, hp, feature_mask, *,
                      num_candidates: int, top_k: int, axis_name: str,
-                     has_categorical: bool = True, loop_factor: int = 1):
+                     has_categorical: bool = True, loop_factor: int = 1,
+                     hist_reduce: str = "psum", num_shards: int = 1):
     """One voting round for one leaf: local top-k proposal -> global vote
     -> candidate-only histogram psum -> global best split.
 
@@ -84,13 +92,42 @@ def _vote_and_reduce(local_hist, pg, ph, pc, parent_out, min_b, max_b,
     cand = cand.astype(jnp.int32)                              # [C]
 
     # --- reduce only the candidates' histograms (ref: :396)
-    cand_hist = obs_health.psum(local_hist[cand], axis_name,
-                                tag="vote/psum_hist",
-                                loop_factor=loop_factor)       # [C, B, 3]
     cand_meta = jax.tree_util.tree_map(lambda a: a[cand], meta)
-    info = find_best_split(cand_hist, pg, ph, pc, cand_meta, hp,
-                           feature_mask[cand], parent_out, min_b, max_b,
-                           depth, has_categorical)
+    if hist_reduce == "scatter" and num_shards > 1:
+        # ReduceScatter over the candidate axis: each shard owns a
+        # contiguous slice of C, embeds it back at its global offset in
+        # an all-zero [C, B, 3] (the ORACLE's shape, so XLA emits the
+        # same split-search arithmetic bit for bit), searches with
+        # non-owned candidates masked off, and one SplitInfo-sized
+        # all_gather + first-max argmax recovers exactly the psum
+        # winner (see parallel/scatter.py for the parity argument).
+        w = num_shards
+        c_pad = -(-num_candidates // w) * w
+        cand_padded = jnp.pad(cand, (0, c_pad - num_candidates),
+                              mode="edge")
+        part = obs_health.psum_scatter(
+            local_hist[cand_padded], axis_name, tag="hist/psum_scatter",
+            loop_factor=loop_factor, scatter_dimension=0)
+        c_loc = c_pad // w
+        idx = lax.axis_index(axis_name)
+        full = lax.dynamic_update_slice(
+            jnp.zeros((c_pad,) + part.shape[1:], part.dtype), part,
+            (idx * c_loc, jnp.int32(0), jnp.int32(0)))[:num_candidates]
+        slot = jnp.arange(num_candidates, dtype=jnp.int32)
+        owned = (slot >= idx * c_loc) & (slot < (idx + 1) * c_loc)
+        info = find_best_split(full, pg, ph, pc, cand_meta, hp,
+                               feature_mask[cand] & owned, parent_out,
+                               min_b, max_b, depth, has_categorical)
+        info = allgather_argmax_best(info, axis_name,
+                                     tag="split/allgather_best",
+                                     loop_factor=loop_factor)
+    else:
+        cand_hist = obs_health.psum(local_hist[cand], axis_name,
+                                    tag="vote/psum_hist",
+                                    loop_factor=loop_factor)  # [C, B, 3]
+        info = find_best_split(cand_hist, pg, ph, pc, cand_meta, hp,
+                               feature_mask[cand], parent_out, min_b,
+                               max_b, depth, has_categorical)
     return info._replace(feature=cand[info.feature])
 
 
@@ -101,7 +138,8 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
                      hist_dtype=jnp.float32, hist_impl: str = "xla",
                      has_categorical: bool = True,
                      mono_pairwise: bool = False,
-                     hist_deterministic: bool = False):
+                     hist_deterministic: bool = False,
+                     hist_reduce: str = "psum", num_shards: int = 1):
     """Grow one tree with voting-parallel split search. Runs INSIDE
     shard_map: all row-indexed inputs are this shard's slice; returned
     TreeArrays are replicated, row_leaf is the local slice.
@@ -125,7 +163,8 @@ def grow_tree_voting(bins_fm, grad, hess, sample_mask, feature_mask,
     vote = functools.partial(_vote_and_reduce, meta=meta, hp=hp,
                              feature_mask=feature_mask, num_candidates=C,
                              top_k=k_eff, axis_name=axis_name,
-                             has_categorical=has_categorical)
+                             has_categorical=has_categorical,
+                             hist_reduce=hist_reduce, num_shards=num_shards)
 
     # --- root: local histogram; global sums by psum (ref: data_parallel
     # root Allreduce, data_parallel_tree_learner.cpp:170)
@@ -300,7 +339,8 @@ def make_sharded_voting_grow(mesh, *, num_leaves: int, max_bins: int,
                              top_k: int, hist_impl: str = "xla",
                              has_categorical: bool = True,
                              mono_pairwise: bool = False,
-                             hist_deterministic: bool = False):
+                             hist_deterministic: bool = False,
+                             hist_reduce: str = "psum"):
     """jit(shard_map(grow_tree_voting)): rows sharded over "data",
     everything else replicated; tree replicated out, row_leaf sharded."""
     grow = functools.partial(grow_tree_voting, num_leaves=num_leaves,
@@ -308,7 +348,9 @@ def make_sharded_voting_grow(mesh, *, num_leaves: int, max_bins: int,
                              hist_impl=hist_impl,
                              has_categorical=has_categorical,
                              mono_pairwise=mono_pairwise,
-                             hist_deterministic=hist_deterministic)
+                             hist_deterministic=hist_deterministic,
+                             hist_reduce=hist_reduce,
+                             num_shards=int(mesh.shape[mesh_lib.DATA_AXIS]))
     data = P(None, mesh_lib.DATA_AXIS)   # bins [F, N]
     rows = P(mesh_lib.DATA_AXIS)         # [N]
     rep = P()

@@ -225,10 +225,14 @@ class TestRuntimeCollectives:
         must keep advancing by exactly one manifest per program call."""
         global_health.enable()
         X, y = make_binary(512)
+        # pin the full-histogram psum oracle: this test exercises the
+        # counter mechanics, not the reduction choice (test_scatter.py
+        # covers the scatter tags)
         bst = lgb.Booster({"objective": "binary", "tree_learner": "voting",
                            "top_k": 2, "tpu_num_shards": 8,
                            "num_leaves": 7, "tpu_wave_max": 0,
-                           "min_data_in_leaf": 5, "verbosity": -1},
+                           "min_data_in_leaf": 5, "verbosity": -1,
+                           "tpu_hist_reduce": "psum"},
                           lgb.Dataset(X, label=y))
         bst.update()
         snap1 = {t: dict(v) for t, v in global_health.runtime.items()}
@@ -248,8 +252,8 @@ class TestRuntimeCollectives:
         global_health.enable()
         mesh = mesh_lib.get_mesh(8)
         out = global_health.probe_collectives(mesh)
-        assert set(out) == {"psum", "all_gather"}
-        for op in ("psum", "all_gather"):
+        assert set(out) == {"psum", "all_gather", "psum_scatter"}
+        for op in ("psum", "all_gather", "psum_scatter"):
             assert global_health.probe[op]["seconds"] > 0
             assert global_health.probe[op]["bytes"] > 0
 

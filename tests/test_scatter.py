@@ -1,0 +1,390 @@
+"""Reduce-scatter histogram aggregation (tpu_hist_reduce=scatter).
+
+The scatter mode must be BIT-IDENTICAL to the full-histogram psum
+oracle (ref: data_parallel_tree_learner.cpp:287-297 ReduceScatter +
+feature-subset search + one-SplitInfo Allgather): same models byte for
+byte via model_to_string, while moving ~1/W of the histogram bytes per
+collective. Satellite: non-divisible row counts now pad + shard rather
+than degrade to replicated storage (boosting._pad_tail guards keep the
+padded tail inert through bagging/GOSS/quantization)."""
+
+import os
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.metrics import _auc
+from lightgbm_tpu.learner import (_sharded_pallas_build,
+                                  _sharded_pallas_multi,
+                                  collective_traffic_model)
+from lightgbm_tpu.obs.health import global_health
+from lightgbm_tpu.ops import histogram as hist_ops
+from lightgbm_tpu.ops.split import split_info_nbytes
+from lightgbm_tpu.parallel import mesh as mesh_lib
+from lightgbm_tpu.parallel.scatter import resolve_hist_reduce
+from tests.conftest import make_binary, make_regression
+
+
+@pytest.fixture(autouse=True)
+def _require_multi_device():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs multi-device (XLA_FLAGS host platform count)")
+
+
+def _train(params, X, y, rounds=3):
+    return lgb.train({"verbosity": -1, **params}, lgb.Dataset(X, label=y),
+                     num_boost_round=rounds)
+
+
+def _model_str(bst):
+    # the A/B knob itself is echoed in the params section; everything
+    # else (trees, feature infos, leaf values) must be byte-identical
+    return "\n".join(l for l in bst.model_to_string().splitlines()
+                     if not l.startswith("[tpu_hist_reduce:"))
+
+
+def _models_equal(pa, pb, X, y, rounds=3):
+    a = _train(pa, X, y, rounds)
+    b = _train(pb, X, y, rounds)
+    return _model_str(a) == _model_str(b)
+
+
+# ---------------------------------------------------------------------------
+# knob resolution + analytic byte model (pure logic)
+
+def test_resolve_hist_reduce():
+    m8 = mesh_lib.get_mesh(8)
+    m1 = mesh_lib.get_mesh(1)
+    assert resolve_hist_reduce("auto", None, 8) == "psum"
+    assert resolve_hist_reduce("auto", m1, 8) == "psum"
+    assert resolve_hist_reduce("scatter", m1, 8) == "psum"
+    assert resolve_hist_reduce("auto", m8, 8) == "scatter"
+    assert resolve_hist_reduce("auto", m8, 13) == "psum"  # uneven
+    assert resolve_hist_reduce("auto", m8, 13, pad_ok=True) == "scatter"
+    assert resolve_hist_reduce("psum", m8, 8) == "psum"
+    assert resolve_hist_reduce("scatter", m8, 13) == "scatter"  # pads
+    with pytest.raises(ValueError):
+        resolve_hist_reduce("ring", m8, 8)
+
+
+def test_collective_traffic_model_reduction():
+    """Modeled bytes/iter at the perf-gate fixture shape: scatter must
+    cut >= 1.8x at W=4 and keep improving with width."""
+    kw = dict(num_features=28, max_bins=15, num_leaves=255, wave_max=42)
+    ratios, hist_ratios = {}, {}
+    for w in (4, 16, 64):
+        psum = collective_traffic_model(width=w, reduction="psum", **kw)
+        scat = collective_traffic_model(width=w, reduction="scatter", **kw)
+        assert scat["split_collective_bytes_per_iter"] > 0
+        ratios[w] = (psum["collective_bytes_per_iter"]
+                     / scat["collective_bytes_per_iter"])
+        # the histogram collective itself shrinks exactly W-fold
+        # (modulo feature-axis padding to a multiple of W)
+        hist_ratios[w] = (psum["hist_collective_bytes_per_iter"]
+                          / scat["hist_collective_bytes_per_iter"])
+        assert hist_ratios[w] == pytest.approx(
+            w * kw["num_features"] / scat["padded_features"])
+    assert ratios[4] >= 1.8
+    assert ratios[16] >= 1.8
+    assert hist_ratios[64] > hist_ratios[16] > hist_ratios[4]
+    # the O(W * SplitInfo) winner exchange eventually dominates on this
+    # SMALL feature set — the model must show the crossover, not hide it
+    assert ratios[64] < ratios[16] < ratios[4]
+    # hierarchical: the DCN hop ships the owned slice once more
+    hier = collective_traffic_model(width=4, dcn=4, reduction="scatter",
+                                    **kw)
+    flat = collective_traffic_model(width=4, reduction="scatter", **kw)
+    assert hier["dcn_bytes_per_iter"] == flat["hist_collective_bytes_per_iter"]
+    assert hier["collective_bytes_per_iter"] > flat[
+        "collective_bytes_per_iter"]
+
+
+def test_split_info_nbytes():
+    # 11 scalar f32/i32 fields + default_left byte + cat_mask[max_bins]
+    assert split_info_nbytes(63) == 11 * 4 + 1 + 63
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: scatter vs the psum oracle, whole-model comparison
+
+PARITY_CASES = {
+    "plain-w8": {"objective": "regression", "num_leaves": 15,
+                 "min_data_in_leaf": 5, "tree_learner": "data"},
+    "plain-w4": {"objective": "regression", "num_leaves": 15,
+                 "min_data_in_leaf": 5, "tree_learner": "data",
+                 "tpu_num_shards": 4},
+    "bagging-w2": {"objective": "binary", "num_leaves": 15,
+                   "tree_learner": "data", "tpu_num_shards": 2,
+                   "bagging_fraction": 0.7, "bagging_freq": 1, "seed": 5},
+    "exact-grower-w8": {"objective": "binary", "num_leaves": 7,
+                        "tree_learner": "data", "tpu_wave_max": 0},
+    "quant-int8-w8": {"objective": "binary", "num_leaves": 15,
+                      "tree_learner": "data", "use_quantized_grad": True},
+    "voting-w8": {"objective": "binary", "num_leaves": 15,
+                  "tree_learner": "voting", "top_k": 2},
+    "feature-w8": {"objective": "regression", "num_leaves": 15,
+                   "tree_learner": "feature", "tpu_wave_max": 0},
+}
+
+
+@pytest.mark.parametrize("case", sorted(PARITY_CASES))
+def test_scatter_bit_parity(case):
+    params = PARITY_CASES[case]
+    make = make_binary if params["objective"] == "binary" else \
+        make_regression
+    X, y = make(512)
+    assert _models_equal({**params, "tpu_hist_reduce": "psum"},
+                         {**params, "tpu_hist_reduce": "scatter"}, X, y), \
+        f"{case}: scatter model differs from the psum oracle"
+
+
+def test_scatter_bit_parity_uneven_features():
+    """F=13 over 8 shards: explicit scatter zero-pads the feature axis to
+    16 and must still reproduce the oracle byte for byte."""
+    X, y = make_binary(512, 13)
+    params = {"objective": "binary", "num_leaves": 15,
+              "tree_learner": "data"}
+    assert _models_equal({**params, "tpu_hist_reduce": "psum"},
+                         {**params, "tpu_hist_reduce": "scatter"}, X, y)
+    # auto demotes the uneven count to psum instead of padding
+    bst = lgb.Booster({**params, "verbosity": -1},
+                      lgb.Dataset(X, label=y))
+    assert bst._gbdt._hist_reduce == "psum"
+
+
+def test_scatter_single_shard_degrades_to_psum():
+    X, y = make_binary(256)
+    bst = _train({"objective": "binary", "num_leaves": 7,
+                  "tree_learner": "data", "tpu_num_shards": 1,
+                  "tpu_hist_reduce": "scatter"}, X, y, rounds=2)
+    assert bst.num_trees() == 2
+
+
+# ---------------------------------------------------------------------------
+# runtime collective counters: the wire payload actually shrinks
+
+def _runtime_snapshot():
+    return {t: dict(e) for t, e in global_health.runtime.items()}
+
+
+def test_scatter_runtime_counters_data_learner():
+    """The scatter program's histogram collective must carry exactly 1/W
+    of the psum oracle's bytes, and the winner exchange must be
+    O(W * sizeof(SplitInfo)) per record — not O(L * F * B)."""
+    X, y = make_regression(512)
+    # pallas impl: the psum oracle then also routes through the
+    # instrumented shard_map builder (the GSPMD xla path's collectives
+    # are partitioner-inserted and carry no runtime counters)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "tree_learner": "data",
+              "tpu_hist_impl": "pallas"}
+    global_health.reset()
+    global_health.enable()
+    try:
+        _train({**params, "tpu_hist_reduce": "psum"}, X, y)
+        psum_rt = _runtime_snapshot()
+        global_health.reset()
+        _train({**params, "tpu_hist_reduce": "scatter"}, X, y)
+        scat_rt = _runtime_snapshot()
+    finally:
+        global_health.disable()
+        global_health.reset()
+    assert "hist/psum_wave" in psum_rt and "hist/psum_scatter" not in psum_rt
+    assert "hist/psum_scatter" in scat_rt and "hist/psum_wave" not in scat_rt
+    assert "split/allgather_best" in scat_rt
+    pw, sc = psum_rt["hist/psum_wave"], scat_rt["hist/psum_scatter"]
+    assert sc["op"] == "psum_scatter"
+    # same wave schedule on both sides -> same issue count, W-fold bytes
+    assert sc["calls"] == pw["calls"]
+    assert sc["bytes"] * 8 == pw["bytes"]
+    # winner exchange: O(W * sizeof(SplitInfo)) per searched record —
+    # the analytic model and the runtime counter must agree exactly
+    ag = scat_rt["split/allgather_best"]
+    bst = lgb.Booster({**params, "verbosity": -1}, lgb.Dataset(X, label=y))
+    shape = bst._gbdt._resolved_hist_shape()
+    model = collective_traffic_model(
+        num_features=8, max_bins=shape["max_bins"], num_leaves=15,
+        wave_max=shape["wave_max"], width=8, reduction="scatter")
+    assert ag["bytes"] == 3 * model["split_collective_bytes_per_iter"]
+    # net win even at this tiny 1-feature-per-shard fixture: the winner
+    # exchange rides on top of the 1/W hist slice but the total still
+    # undercuts the full-histogram psum
+    assert ag["bytes"] + sc["bytes"] < pw["bytes"]
+
+
+def test_scatter_runtime_counters_voting():
+    """Voting + scatter: the candidate-axis ReduceScatter replaces the
+    candidate psum, and each winner combine gathers one SplitInfo per
+    shard."""
+    X, y = make_binary(512)
+    params = {"objective": "binary", "num_leaves": 7,
+              "tree_learner": "voting", "top_k": 2}
+    global_health.reset()
+    global_health.enable()
+    try:
+        _train({**params, "tpu_hist_reduce": "psum"}, X, y, rounds=2)
+        psum_rt = _runtime_snapshot()
+        global_health.reset()
+        _train({**params, "tpu_hist_reduce": "scatter"}, X, y, rounds=2)
+        scat_rt = _runtime_snapshot()
+    finally:
+        global_health.disable()
+        global_health.reset()
+    assert "vote/psum_hist" in psum_rt
+    assert "vote/psum_hist" not in scat_rt
+    assert scat_rt["hist/psum_scatter"]["bytes"] < \
+        psum_rt["vote/psum_hist"]["bytes"]
+    ag = scat_rt["split/allgather_best"]
+    bst = lgb.Booster({**params, "verbosity": -1}, lgb.Dataset(X, label=y))
+    max_bins = bst._gbdt._static["max_bins"]
+    # gathered payload per issue: one SplitInfo from each of 8 shards
+    assert ag["bytes"] == ag["calls"] * 8 * split_info_nbytes(max_bins)
+
+
+# ---------------------------------------------------------------------------
+# satellite: non-divisible rows pad + shard instead of replicating
+
+def test_row_pad_keeps_rows_sharded():
+    """N=1003 over 8 shards used to fall back to fully replicated row
+    tensors; now the storage pads to 1008 and stays sharded."""
+    X, y = make_regression(1003)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1, "seed": 7}
+    with pytest.warns(UserWarning, match="padding row tensors"):
+        bst = lgb.Booster({**params, "tree_learner": "data"},
+                          lgb.Dataset(X, label=y))
+    g = bst._gbdt
+    assert g._row_pad == 5
+    assert g.num_data == 1003
+    assert g.bins_fm.shape[1] == 1008
+    assert g.scores.shape[1] == 1008
+    assert g.bins_fm.sharding.spec[1] is not None  # rows still sharded
+    assert g._sample_mask.shape[0] == 1008
+    for _ in range(8):
+        bst.update()
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=8)
+    np.testing.assert_allclose(bst.predict(X), serial.predict(X),
+                               rtol=1e-3, atol=1e-3)
+    # train-side score egress drops the padded tail
+    ev = bst.eval_train()
+    assert ev and np.isfinite(ev[0][2])
+
+
+def test_row_pad_bagging_matches_serial():
+    """Padded tail through the bagging draw: u pads with 2.0 (never
+    sampled) and the real-row draws keep their bits."""
+    X, y = make_regression(1003)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "verbosity": -1, "seed": 11,
+              "bagging_fraction": 0.8, "bagging_freq": 1}
+    serial = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                       num_boost_round=8)
+    par = lgb.train({**params, "tree_learner": "data"},
+                    lgb.Dataset(X, label=y), num_boost_round=8)
+    np.testing.assert_allclose(par.predict(X), serial.predict(X),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_row_pad_goss_quality():
+    """GOSS over padded storage: the tail scores -1 (never top-k) and its
+    keep-draw is 2.0 (never kept)."""
+    X, y = make_binary(1003)
+    bst = _train({"objective": "binary", "num_leaves": 15,
+                  "tree_learner": "data",
+                  "data_sample_strategy": "goss"}, X, y, rounds=10)
+    assert bst._gbdt._row_pad == 5
+    assert _auc(y, bst.predict(X)) > 0.9
+
+
+# ---------------------------------------------------------------------------
+# hierarchical ("dcn", "ici") reduction
+
+def test_hierarchical_mesh_shapes():
+    hm = mesh_lib.get_hierarchical_mesh(jax.devices(), num_groups=2)
+    assert hm.axis_names == ("dcn", "ici")
+    assert hm.shape["dcn"] == 2 and hm.shape["ici"] == 4
+    with pytest.raises(ValueError):
+        mesh_lib.get_hierarchical_mesh(jax.devices()[:6], num_groups=4)
+
+
+def test_hierarchical_int8_scatter_exact():
+    """2x4 mesh, int32 quantized histograms: ICI reduce-scatter + DCN
+    psum of the owned slice must be EXACTLY the single-device integer
+    result (integer accumulation commutes)."""
+    from lightgbm_tpu.ops.pallas_histogram import hist_multi_int8_xla
+
+    r = np.random.RandomState(4)
+    n, f, b, slots = 1003, 8, 15, 8
+    bins = jnp.asarray(r.randint(0, b, (f, n)), jnp.uint8)
+    mask = (r.rand(n) < 0.8).astype(np.int8)
+    ghT_i8 = jnp.asarray(np.stack([(r.randint(-3, 4, n) * mask),
+                                   (r.randint(0, 5, n) * mask), mask],
+                                  axis=1), jnp.int8)
+    row_leaf = jnp.asarray(r.randint(0, slots, n), jnp.int32)
+    ids = jnp.asarray(np.arange(slots, dtype=np.int32))
+    hm = mesh_lib.get_hierarchical_mesh(jax.devices(), num_groups=2)
+    sharded = _sharded_pallas_multi(hm, max_bins=b, precision="highest",
+                                    int8=True, impl="xla",
+                                    hist_reduce="scatter")
+    out = np.asarray(sharded(bins, ghT_i8, row_leaf, ids))
+    ref = np.asarray(hist_multi_int8_xla(bins, ghT_i8, row_leaf, ids,
+                                         max_bins=b, num_slots=slots))
+    assert out.dtype == np.int32
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_hierarchical_f32_scatter_close():
+    """f32 on the 2x4 mesh: hierarchical regrouping reorders the f32
+    sums, so allclose (not bitwise) against the single-device build."""
+    r = np.random.RandomState(1)
+    n, f, b = 1024, 8, 15
+    bins = jnp.asarray(r.randint(0, b, (f, n)), jnp.uint8)
+    g = jnp.asarray(r.randn(n), jnp.float32)
+    h = jnp.asarray(np.abs(r.randn(n)) + 0.1, jnp.float32)
+    m = jnp.asarray((r.rand(n) < 0.8), jnp.float32)
+    hm = mesh_lib.get_hierarchical_mesh(jax.devices(), num_groups=2)
+    sharded = _sharded_pallas_build(hm, max_bins=b, dtype=jnp.float32,
+                                    row_chunk=0, precision="highest",
+                                    impl="xla", hist_reduce="scatter")
+    out = np.asarray(sharded(bins, g, h, m))
+    ref = np.asarray(hist_ops.build_histogram(
+        bins, g, h, m, max_bins=b, dtype=jnp.float32, row_chunk=0,
+        impl="xla"))
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# published byte model
+
+def test_booster_publishes_collective_meta():
+    from lightgbm_tpu.obs.metrics import global_metrics
+
+    X, y = make_regression(512)
+    lgb.Booster({"objective": "regression", "num_leaves": 15,
+                 "tree_learner": "data", "verbosity": -1},
+                lgb.Dataset(X, label=y))
+    ct = global_metrics.meta.get("collective_traffic")
+    assert ct is not None
+    assert ct["reduction"] == "scatter"  # auto picks scatter on 8 shards
+    assert ct["width"] == 8
+    oracle = global_metrics.meta["collective_traffic_psum"]
+    assert oracle["reduction"] == "psum"
+    red = global_metrics.meta["collective_reduction"]
+    # published rounded for the bench JSON line
+    assert red == pytest.approx(
+        oracle["collective_bytes_per_iter"]
+        / ct["collective_bytes_per_iter"], abs=5e-5)
+    assert red > 1.8
+
+
+def test_check_scatter_tool():
+    """The standalone CI validator (quick tier, mirrors check_shap)."""
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+    import check_scatter
+    assert check_scatter.main() == 0

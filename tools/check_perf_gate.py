@@ -2,7 +2,7 @@
 """Perf-regression gate (ROADMAP item 4: convert "should be fast" into
 driver-visible proof).
 
-Thirteen checks, all against the recorded floor in tools/perf_floor.json:
+Fourteen checks, all against the recorded floor in tools/perf_floor.json:
 
 1. **Histogram traffic model** — recomputes the static per-iteration
    HBM byte model (learner.hist_traffic_model) for the recorded
@@ -107,6 +107,17 @@ Thirteen checks, all against the recorded floor in tools/perf_floor.json:
     measured path-table pack bytes must land inside the configured
     band of the analytic memory model's ``shap_pack`` component
     (check_shap). Graceful skip when no shap bench ran.
+
+14. **Collective scatter reduction** — recomputes the static
+    per-iteration cross-device collective byte model
+    (learner.collective_traffic_model) for the recorded fixture shape
+    under both reductions and fails if the reduce-scatter learner's
+    modeled collective bytes stopped beating the full-histogram psum
+    oracle by the recorded factor at the fixture width (ISSUE 20
+    acceptance: >= 1.8x at W=4). Purely analytic — no devices in the
+    loop — so a code change that silently re-widens the all_gather
+    payload or drops the feature partition trips this on any host.
+    Graceful skip when no scatter floor is recorded.
 
 Exit 0 = gate passed; exit 1 = regression, with one line per failure.
 Wired into the quick verification tier via tests/test_perf_gate.py.
@@ -881,6 +892,34 @@ def check_shap(floor, failures, candidate_path=None):
               f"depth={int(sh.get('depth', 0))}")
 
 
+def check_collective_scatter(floor, failures):
+    """Reduce-scatter collective byte model vs psum oracle (check 14)."""
+    sc = floor.get("scatter")
+    if not sc:
+        print("# no scatter floor recorded; collective-scatter check "
+              "skipped")
+        return
+    from lightgbm_tpu.learner import collective_traffic_model
+    fx = sc["fixture"]
+    shape = dict(num_features=fx["num_features"], max_bins=fx["max_bins"],
+                 num_leaves=fx["num_leaves"], wave_max=fx["wave_max"],
+                 width=fx["width"])
+    psum = collective_traffic_model(**shape, reduction="psum")
+    scat = collective_traffic_model(**shape, reduction="scatter")
+    ratio = (psum["collective_bytes_per_iter"]
+             / scat["collective_bytes_per_iter"])
+    min_red = float(sc["min_collective_reduction_w4"])
+    if ratio < min_red:
+        failures.append(
+            f"collective scatter reduction fell to {ratio:.2f}x "
+            f"< required {min_red}x at W={fx['width']} "
+            f"(scatter {scat['collective_bytes_per_iter']/1e3:.0f} KB/iter "
+            f"vs psum {psum['collective_bytes_per_iter']/1e3:.0f} KB/iter)")
+    print(f"# collective scatter: {ratio:.2f}x vs psum at W={fx['width']} "
+          f"({scat['collective_bytes_per_iter']/1e3:.0f} KB/iter vs "
+          f"{psum['collective_bytes_per_iter']/1e3:.0f} KB/iter)")
+
+
 def check_bench_trajectory(floor, failures, lines, candidate_rec=None):
     if not lines:
         print("# no BENCH_*.json lines found; trajectory check skipped")
@@ -942,6 +981,7 @@ def main(argv=None) -> int:
     check_profile_roofline(floor, failures, candidate)
     check_fleet_availability(floor, failures, candidate)
     check_shap(floor, failures, candidate)
+    check_collective_scatter(floor, failures)
     if failures:
         for f in failures:
             print(f"PERF GATE FAIL: {f}")

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""CI smoke for the reduce-scatter data-parallel learner
+(tpu_hist_reduce=scatter; parallel/scatter.py + the sharded builders in
+learner.py).
+
+Three assertions, mirroring tools/check_shap.py for the scatter
+subsystem:
+
+1. **Oracle bit-parity**: a quick data-parallel train with
+   ``tpu_hist_reduce=scatter`` produces a ``model_to_string`` that is
+   BYTE-identical to the full-histogram psum oracle on the virtual
+   8-device CPU mesh — the whole point of the embed-at-oracle-shape
+   split search (ref: data_parallel_tree_learner.cpp:287-297).
+2. **Wire payload**: the runtime collective counters (obs/health.py)
+   show the scatter histogram collective carrying exactly 1/W of the
+   psum oracle's bytes at the same issue count, and the winner
+   exchange gathering exactly one SplitInfo per shard per searched
+   record — O(W * sizeof(SplitInfo)), not O(L * F * B).
+3. **Metrics lint**: the rendered OpenMetrics document carries the new
+   collective tags (``hist/psum_scatter``, ``split/allgather_best``)
+   under the ``lgbmtpu_health_collective_*`` families, and the booster
+   publishes the modeled ``collective_reduction`` meta that bench.py
+   folds into its JSON line.
+
+Skips (exit 0 with a notice) when fewer than 2 devices are visible —
+the scatter mode demotes itself to psum there, so there is nothing to
+check. Exit 0 = pass. Usage: python tools/check_scatter.py
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main() -> int:
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.learner import collective_traffic_model
+    from lightgbm_tpu.obs.export import render_openmetrics
+    from lightgbm_tpu.obs.health import global_health
+    from lightgbm_tpu.obs.metrics import global_metrics
+    from lightgbm_tpu.ops.split import split_info_nbytes
+
+    width = len(jax.devices())
+    if width < 2:
+        print("check_scatter: skipped (single device — scatter demotes "
+              "to psum)")
+        return 0
+
+    failures = 0
+    rng = np.random.RandomState(0)
+    n, f = 512, 8
+    x = rng.randn(n, f)
+    y = (x[:, 0] * 2.0 - x[:, 1] + 0.5 * x[:, 2] ** 2
+         + 0.1 * rng.randn(n)).astype(np.float32)
+    # pallas impl so the psum oracle also routes through the
+    # instrumented shard_map builder (the GSPMD xla path's collectives
+    # are partitioner-inserted and carry no runtime counters)
+    params = {"objective": "regression", "num_leaves": 15,
+              "min_data_in_leaf": 5, "tree_learner": "data",
+              "tpu_hist_impl": "pallas", "verbosity": -1}
+    rounds = 3
+
+    def train(reduce):
+        bst = lgb.train({**params, "tpu_hist_reduce": reduce},
+                        lgb.Dataset(x, label=y), num_boost_round=rounds)
+        return bst, {t: dict(e) for t, e in global_health.runtime.items()}
+
+    global_health.reset()
+    global_health.enable()
+    try:
+        bst_psum, psum_rt = train("psum")
+        global_health.reset()
+        bst_scat, scat_rt = train("scatter")
+        doc = render_openmetrics()
+    finally:
+        global_health.disable()
+        global_health.reset()
+
+    # 1. bit-parity vs the psum oracle (the echoed knob line itself is
+    # the one legitimate difference)
+    def model_str(bst):
+        return "\n".join(l for l in bst.model_to_string().splitlines()
+                         if not l.startswith("[tpu_hist_reduce:"))
+
+    if model_str(bst_scat) != model_str(bst_psum):
+        print("FAIL: scatter model differs from the psum oracle "
+              "(model_to_string mismatch)")
+        failures += 1
+
+    # 2. the wire payload actually shrank
+    pw = psum_rt.get("hist/psum_wave")
+    sc = scat_rt.get("hist/psum_scatter")
+    ag = scat_rt.get("split/allgather_best")
+    if pw is None or sc is None or ag is None:
+        print(f"FAIL: runtime counters missing (psum tags "
+              f"{sorted(psum_rt)}, scatter tags {sorted(scat_rt)})")
+        failures += 1
+    else:
+        if sc["calls"] != pw["calls"] or sc["bytes"] * width != pw["bytes"]:
+            print(f"FAIL: scatter hist collective not 1/{width} of the "
+                  f"psum bytes at equal issue count (psum {pw}, "
+                  f"scatter {sc})")
+            failures += 1
+        shape = bst_scat._gbdt._resolved_hist_shape()
+        model = collective_traffic_model(
+            num_features=f, max_bins=shape["max_bins"],
+            num_leaves=params["num_leaves"], wave_max=shape["wave_max"],
+            width=width, reduction="scatter")
+        want_ag = rounds * model["split_collective_bytes_per_iter"]
+        if ag["bytes"] != want_ag:
+            print(f"FAIL: winner all_gather carried {ag['bytes']} B, "
+                  f"model says {want_ag} B "
+                  f"({width} shards x {split_info_nbytes(shape['max_bins'])}"
+                  f" B per searched record)")
+            failures += 1
+        if ag["bytes"] + sc["bytes"] >= pw["bytes"]:
+            print(f"FAIL: scatter total ({ag['bytes']} + {sc['bytes']} B) "
+                  f"did not undercut the psum oracle ({pw['bytes']} B)")
+            failures += 1
+
+    # 3. OpenMetrics lint + published byte model
+    for needle in ('tag="hist/psum_scatter"', 'tag="split/allgather_best"',
+                   "lgbmtpu_health_collective_bytes_total"):
+        if needle not in doc:
+            print(f"FAIL: {needle} missing from the rendered OpenMetrics "
+                  "document")
+            failures += 1
+    ct = global_metrics.meta.get("collective_traffic")
+    red = global_metrics.meta.get("collective_reduction")
+    if not ct or ct.get("reduction") != "scatter":
+        print(f"FAIL: booster did not publish scatter collective_traffic "
+              f"meta (got {ct})")
+        failures += 1
+    elif red is None or red < 1.8:
+        print(f"FAIL: published collective_reduction {red} < 1.8x")
+        failures += 1
+
+    if failures:
+        print(f"check_scatter: {failures} failure(s)")
+        return 1
+    print(f"check_scatter: OK (bit-parity with the psum oracle on "
+          f"{width} shards, hist collective bytes /{width}, winner "
+          f"exchange {ag['bytes']} B = {rounds} iters x {width} x "
+          f"SplitInfo, modeled reduction {red:.2f}x)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
